@@ -1,0 +1,93 @@
+"""Benchmark: contrastive-training throughput in pages/sec/chip
+(the primary metric, BASELINE.json:2), run on whatever accelerator the
+environment provides (the driver runs this on one real TPU chip).
+
+Method: flagship two-tower BERT-mini (config 3 geometry), pre-tokenized
+batches resident on device (host tokenization is benched separately and is
+not the device metric), jit-compiled train step with donated state; warmup
+then timed steps. Prints ONE JSON line.
+
+vs_baseline: BASELINE.json publishes no reference numbers ("published": {},
+see BASELINE.md) — the ratio is computed against the most recent
+BENCH_r*.json recorded by the driver, or 1.0 when none exists yet.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+import numpy as np
+
+
+def _previous_bench() -> float | None:
+    best = None
+    for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".",
+                                       "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            cand = (int(m.group(1)), float(rec["value"]))
+        except Exception:
+            continue
+        if best is None or cand[0] > best[0]:
+            best = cand
+    return None if best is None else best[1]
+
+
+def main() -> None:
+    import jax
+
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.train.loop import Trainer
+
+    n_dev = len(jax.devices())
+    cfg = get_config("bert_mini_v5p16", {
+        "data.num_pages": max(2_048, 256 * n_dev),
+        "data.query_len": 16,
+        "data.page_len": 64,
+        "train.batch_size": 256 * n_dev,
+        "train.steps": 40,
+        "train.log_every": 1_000_000,   # keep logging off the timed path
+        "mesh.data": n_dev,
+    })
+    trainer = Trainer(cfg, workdir="/tmp/dnn_page_vectors_tpu_bench")
+    state = trainer.init_state()
+    step_fn = trainer.compiled_step(state)
+
+    # Pre-materialize a few batches on device: the metric is device
+    # training throughput; the host pipeline overlaps in production.
+    from dnn_page_vectors_tpu.parallel.sharding import replicated
+    it = iter(trainer.batches())
+    batches = [next(it) for _ in range(4)]
+    base_rng = jax.device_put(jax.random.PRNGKey(0), replicated(trainer.mesh))
+
+    for i in range(5):  # warmup + compile
+        state, metrics = step_fn(state, batches[i % len(batches)], base_rng)
+    jax.block_until_ready(state.params)
+
+    timed_steps = cfg.train.steps
+    t0 = time.perf_counter()
+    for i in range(timed_steps):
+        state, metrics = step_fn(state, batches[i % len(batches)], base_rng)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    pages_per_sec_per_chip = cfg.train.batch_size * timed_steps / dt / n_dev
+    prev = _previous_bench()
+    vs = pages_per_sec_per_chip / prev if prev else 1.0
+    print(json.dumps({
+        "metric": "train_pages_per_sec_per_chip",
+        "value": round(pages_per_sec_per_chip, 2),
+        "unit": "pages/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

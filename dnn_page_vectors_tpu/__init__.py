@@ -1,0 +1,20 @@
+"""dnn_page_vectors_tpu — a TPU-native web-page embedding framework.
+
+Capability-parity rebuild of `collawolley/dnn_page_vectors` (reference mount
+was empty at survey time; spec reconstructed in SURVEY.md from BASELINE.json):
+two-tower page encoders (CDSSM char-trigram CNN, Kim-CNN, BERT-mini, mT5-base)
+trained with a cosine-contrastive loss over global in-batch and ANN-mined hard
+negatives, a sharded corpus->vector bulk-embed job, and Recall@10 retrieval
+eval.
+
+TPU-first design notes (vs. the reference's torch-DDP/NCCL path,
+BASELINE.json:5):
+  * the trainer writes *global* batch math once; GSPMD (jit + NamedSharding
+    over a `jax.sharding.Mesh`) partitions it and inserts ICI collectives —
+    there is no user-level all-reduce hook.
+  * all hot paths are jit-compiled, static-shape, bfloat16-on-MXU.
+  * host-side work (tokenization, IO) stays off the compiled path behind a
+    double-buffered prefetch queue.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,144 @@
+"""CLI: one entry point per workflow (SURVEY.md §3 #25; call stacks §4.1-4.4).
+
+  python -m dnn_page_vectors_tpu.cli train --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli embed --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli eval  --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli mine  --config hardneg_v5p64
+
+Any config field is overridable with --set section.field=value; every flag
+round-trips through the Config dataclasses (SURVEY.md §5.6).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict
+
+from dnn_page_vectors_tpu.config import CONFIGS, get_config
+
+
+def _parse_overrides(pairs) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for pair in pairs or []:
+        key, _, value = pair.partition("=")
+        out[key] = value
+    return out
+
+
+def _trainer(cfg):
+    from dnn_page_vectors_tpu.train.loop import Trainer
+    lookup = None
+    negs_path = os.path.join(cfg.workdir, "hard_negatives.npy")
+    if cfg.train.hard_negatives > 0 and os.path.exists(negs_path):
+        # close the mine -> train loop (config 4): feed mined negatives back
+        from dnn_page_vectors_tpu.mine.ann import HardNegatives
+        lookup = HardNegatives.load(negs_path)
+    return Trainer(cfg, hard_negative_lookup=lookup)
+
+
+def _embedder(cfg, trainer, state):
+    from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+    return BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                        trainer.mesh, query_tok=trainer.query_tok)
+
+
+def _restore_or_init(cfg, trainer):
+    """Returns (state, ckpt_manager); state is restored from the latest
+    checkpoint when one exists."""
+    from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+    state = trainer.init_state()
+    mgr = CheckpointManager(os.path.join(cfg.workdir, "ckpt"))
+    if mgr.latest_step() is not None:
+        state = mgr.restore(state)
+    return state, mgr
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="dnn_page_vectors_tpu")
+    ap.add_argument("command", choices=["train", "embed", "eval", "mine",
+                                        "configs"])
+    ap.add_argument("--config", default="cdssm_toy", choices=sorted(CONFIGS))
+    ap.add_argument("--set", dest="overrides", action="append",
+                    metavar="section.field=value")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--profile", action="store_true",
+                    help="dump a jax.profiler trace under workdir/trace")
+    args = ap.parse_args(argv)
+
+    if args.command == "configs":
+        for name in sorted(CONFIGS):
+            print(name)
+        return
+
+    cfg = get_config(args.config, _parse_overrides(args.overrides))
+    if args.workdir:
+        cfg = cfg.replace(workdir=args.workdir)
+
+    from dnn_page_vectors_tpu.parallel.mesh import multihost_init
+    multihost_init()
+
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    from dnn_page_vectors_tpu.utils.profiling import maybe_profile
+
+    trainer = _trainer(cfg)
+    store_dir = os.path.join(cfg.workdir, "store")
+
+    if args.command == "train":
+        state, mgr = _restore_or_init(cfg, trainer)
+        # bare re-run after a crash completes to the CONFIGURED total (resume
+        # equivalence, §5.4); --steps N explicitly means "N more steps".
+        steps = (max(0, cfg.train.steps - int(state.step))
+                 if args.steps is None else args.steps)
+        with maybe_profile(args.profile, cfg.workdir):
+            state, metrics = trainer.train(steps=steps, state=state,
+                                           ckpt_manager=mgr)
+        mgr.save(int(state.step), state, wait=True)
+        mgr.close()
+        print(json.dumps({"final": metrics}, sort_keys=True))
+        return
+
+    state, mgr = _restore_or_init(cfg, trainer)
+    if mgr.latest_step() is None:
+        import sys
+        print(f"WARNING: no checkpoint under {cfg.workdir}/ckpt — "
+              f"'{args.command}' is running with RANDOM params; "
+              "run 'train' first (or check --workdir)", file=sys.stderr)
+    mgr.close()
+    embedder = _embedder(cfg, trainer, state)
+
+    if args.command == "embed":
+        store = VectorStore(store_dir, dim=cfg.model.out_dim)
+        # vectors from an older checkpoint are stale, not resumable work: a
+        # finished shard only counts if it came from the same model step.
+        # An unstamped store with shards is ambiguous -> reset (fresh stores
+        # have no shards, so resetting them is free).
+        model_step = int(state.step)
+        if store.manifest.get("model_step") != model_step:
+            store.reset()
+        store.manifest["model_step"] = model_step
+        store._flush_manifest()
+        with maybe_profile(args.profile, cfg.workdir):
+            embedder.embed_corpus(trainer.corpus, store)
+        print(json.dumps({"embedded": store.num_vectors,
+                          "model_step": model_step}))
+    elif args.command == "eval":
+        from dnn_page_vectors_tpu.evals.recall import evaluate_recall
+        store = VectorStore(store_dir)
+        recall, nq = evaluate_recall(embedder, trainer.corpus, store,
+                                     k=cfg.eval.recall_k)
+        print(json.dumps({f"recall@{cfg.eval.recall_k}": recall,
+                          "num_queries": nq}, sort_keys=True))
+    elif args.command == "mine":
+        from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives
+        store = VectorStore(store_dir)
+        negs = mine_hard_negatives(embedder, trainer.corpus, store,
+                                   num_negatives=cfg.train.hard_negatives or 7)
+        out = os.path.join(cfg.workdir, "hard_negatives.npy")
+        negs.save(out)
+        print(json.dumps({"mined": list(negs.table.shape), "path": out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,236 @@
+"""Config system: dataclasses + the five canonical named configs.
+
+The five configs mirror BASELINE.json:6-12 verbatim (SURVEY.md §3 #24):
+  1. cdssm_toy      — CDSSM char-trigram CNN, 10k-page toy corpus, single CPU
+  2. kim_cnn_v5e8   — Word-CNN (Kim-CNN) page encoder, 1M pages, DP pjit, v5e-8
+  3. bert_mini_v5p16 — two-tower BERT-mini with in-batch negatives, v5p-16
+  4. hardneg_v5p64  — ANN-mined hard-negative contrastive training, 100M pages
+  5. mt5_multilingual — mT5-base page encoder + cross-lingual retrieval eval
+
+Every CLI flag round-trips through these dataclasses (SURVEY.md §5.6).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Host-side data pipeline settings."""
+    tokenizer: str = "trigram"       # trigram | word | wordpiece | sentencepiece
+    corpus: str = "toy"              # toy | jsonl:<path>
+    num_pages: int = 10_000          # corpus size (toy generator)
+    query_len: int = 16              # max words per query
+    page_len: int = 64               # max words per page
+    trigrams_per_word: int = 8       # K trigram ids kept per word (CDSSM)
+    trigram_buckets: int = 16_384    # hash-bucket vocab for char trigrams
+    vocab_size: int = 30_000         # word / subword vocab size
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Encoder zoo settings. `encoder` selects the family."""
+    encoder: str = "cdssm"           # cdssm | kim_cnn | bert | t5
+    embed_dim: int = 128             # token/word embedding width
+    out_dim: int = 128               # final vector dimension (both towers)
+    # conv families
+    conv_widths: Tuple[int, ...] = (3,)        # cdssm: (3,); kim_cnn: (3, 4, 5)
+    conv_channels: int = 256
+    # transformer families
+    num_layers: int = 4
+    num_heads: int = 4
+    mlp_dim: int = 1024
+    model_dim: int = 256
+    dropout: float = 0.1
+    shared_towers: bool = False      # share params between query/page towers
+    dtype: str = "bfloat16"          # compute dtype on MXU
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh shape. Axes: data (DP) and model (TP).
+
+    The reference scaled with torch-DDP over NCCL (BASELINE.json:5); here the
+    same role is played by GSPMD sharding over this mesh, with XLA emitting
+    psum/all-gather over ICI.
+    """
+    data: int = 1
+    model: int = 1
+    # strict=True: fail hard when fewer devices are visible than configured
+    # (production pods); strict=False: shrink to fit with a loud warning
+    # (dev boxes, tests, the 1-chip sandbox).
+    strict: bool = False
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.model
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    batch_size: int = 256            # GLOBAL batch (split across mesh 'data')
+    steps: int = 1_000
+    optimizer: str = "adamw"         # adamw | sgd
+    learning_rate: float = 1e-3
+    warmup_steps: int = 100
+    weight_decay: float = 0.01
+    temperature_init: float = 20.0   # learnable inverse-temperature init
+    hard_negatives: int = 0          # ANN-mined negatives per positive
+    checkpoint_every: int = 500
+    log_every: int = 50
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalConfig:
+    recall_k: int = 10               # Recall@10 query->page (BASELINE.json:2)
+    eval_queries: int = 1_000
+    embed_batch_size: int = 512
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    name: str
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
+    workdir: str = "/tmp/dnn_page_vectors_tpu"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def _nested_replace(cfg: Config, overrides: Dict[str, Any]) -> Config:
+    """Apply dotted-path overrides, e.g. {"train.steps": 10}."""
+    for path, value in overrides.items():
+        parts = path.split(".")
+        if len(parts) == 1:
+            cfg = dataclasses.replace(cfg, **{parts[0]: value})
+            continue
+        section = getattr(cfg, parts[0])
+        if not isinstance(value, (tuple, list)):
+            # coerce CLI strings to the dataclass field's current type
+            current = getattr(section, parts[1])
+            if isinstance(current, bool):
+                if value in (True, "true", "True", "1", 1):
+                    value = True
+                elif value in (False, "false", "False", "0", 0):
+                    value = False
+                else:
+                    raise ValueError(
+                        f"bad boolean for {path}: {value!r} (use true/false)")
+            elif isinstance(current, int):
+                value = int(value)
+            elif isinstance(current, float):
+                value = float(value)
+            elif isinstance(current, tuple):
+                value = tuple(int(x) for x in str(value).split(","))
+        elif isinstance(value, list):
+            value = tuple(value)
+        section = dataclasses.replace(section, **{parts[1]: value})
+        cfg = dataclasses.replace(cfg, **{parts[0]: section})
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# The five canonical configs (BASELINE.json:6-12).
+# ---------------------------------------------------------------------------
+
+def cdssm_toy() -> Config:
+    """Config 1: 'CDSSM char-trigram CNN, 10k-page toy corpus, single-process
+    CPU' (BASELINE.json:7). The integration oracle of SURVEY.md §5."""
+    return Config(
+        name="cdssm_toy",
+        data=DataConfig(tokenizer="trigram", corpus="toy", num_pages=10_000),
+        model=ModelConfig(encoder="cdssm", conv_widths=(3,), conv_channels=256,
+                          embed_dim=128, out_dim=128, dtype="float32"),
+        mesh=MeshConfig(data=1),
+        train=TrainConfig(batch_size=256, steps=1_000),
+    )
+
+
+def kim_cnn_v5e8() -> Config:
+    """Config 2: 'Word-CNN (Kim-CNN) page encoder, 1M pages, data-parallel
+    pjit on v5e-8' (BASELINE.json:8)."""
+    return Config(
+        name="kim_cnn_v5e8",
+        data=DataConfig(tokenizer="word", corpus="toy", num_pages=1_000_000,
+                        vocab_size=100_000),
+        model=ModelConfig(encoder="kim_cnn", conv_widths=(3, 4, 5),
+                          conv_channels=256, embed_dim=256, out_dim=256),
+        mesh=MeshConfig(data=8),
+        train=TrainConfig(batch_size=4_096, steps=50_000),
+    )
+
+
+def bert_mini_v5p16() -> Config:
+    """Config 3: 'Two-tower BERT-mini (query + page) with in-batch negatives
+    on v5p-16' (BASELINE.json:9). BERT-mini: L=4, H=256, A=4."""
+    return Config(
+        name="bert_mini_v5p16",
+        data=DataConfig(tokenizer="wordpiece", corpus="toy",
+                        num_pages=10_000_000, vocab_size=30_522),
+        model=ModelConfig(encoder="bert", num_layers=4, num_heads=4,
+                          model_dim=256, mlp_dim=1024, out_dim=256),
+        mesh=MeshConfig(data=16),
+        train=TrainConfig(batch_size=8_192, steps=100_000,
+                          learning_rate=5e-4),
+    )
+
+
+def hardneg_v5p64() -> Config:
+    """Config 4: 'Hard-negative ANN-mined contrastive training, 100M pages,
+    v5p-64' (BASELINE.json:10)."""
+    return Config(
+        name="hardneg_v5p64",
+        data=DataConfig(tokenizer="wordpiece", corpus="toy",
+                        num_pages=100_000_000, vocab_size=30_522),
+        model=ModelConfig(encoder="bert", num_layers=4, num_heads=4,
+                          model_dim=256, mlp_dim=1024, out_dim=256),
+        mesh=MeshConfig(data=64),
+        train=TrainConfig(batch_size=16_384, steps=200_000,
+                          hard_negatives=7, learning_rate=5e-4),
+    )
+
+
+def mt5_multilingual() -> Config:
+    """Config 5: 'Multilingual mT5-base page encoder + cross-lingual
+    retrieval eval' (BASELINE.json:11). mT5-base encoder: L=12, d=768,
+    heads=12, ff=2048; model axis gives optional TP (SURVEY.md §3 #14)."""
+    return Config(
+        name="mt5_multilingual",
+        data=DataConfig(tokenizer="sentencepiece", corpus="toy",
+                        num_pages=10_000_000, vocab_size=250_112,
+                        page_len=128),
+        model=ModelConfig(encoder="t5", num_layers=12, num_heads=12,
+                          model_dim=768, mlp_dim=2048, out_dim=768),
+        mesh=MeshConfig(data=4, model=2),
+        train=TrainConfig(batch_size=4_096, steps=100_000,
+                          learning_rate=1e-4),
+    )
+
+
+CONFIGS = {
+    "cdssm_toy": cdssm_toy,
+    "kim_cnn_v5e8": kim_cnn_v5e8,
+    "bert_mini_v5p16": bert_mini_v5p16,
+    "hardneg_v5p64": hardneg_v5p64,
+    "mt5_multilingual": mt5_multilingual,
+}
+
+
+def get_config(name: str, overrides: Optional[Dict[str, Any]] = None) -> Config:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown config {name!r}; have {sorted(CONFIGS)}")
+    cfg = CONFIGS[name]()
+    if overrides:
+        cfg = _nested_replace(cfg, overrides)
+    return cfg

@@ -1,0 +1,11 @@
+"""Host-side data layer: corpora, tokenizers, batching, device prefetch.
+
+Per BASELINE.json:5 the tokenizer/data-loader stays on the (TPU-VM) host,
+feeding device prefetch queues; nothing in this package traces into XLA.
+"""
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.data.trigram import TrigramTokenizer
+from dnn_page_vectors_tpu.data.words import WordTokenizer
+from dnn_page_vectors_tpu.data.subword import SubwordTokenizer
+
+__all__ = ["ToyCorpus", "TrigramTokenizer", "WordTokenizer", "SubwordTokenizer"]

@@ -1,0 +1,53 @@
+"""Real-corpus reader: jsonl files of {"query": ..., "page": ...} records
+(SURVEY.md §3 #4 'corpus readers'). Record id = line number, mirroring the
+ToyCorpus interface so every pipeline runs unchanged on user data.
+
+Texts are held in memory on the host (the loader is host-side per
+BASELINE.json:5); at 1B-page scale a deployment shards the corpus into one
+jsonl file per host and each process reads only its shard (the bulk-embed
+job already sweeps [start, stop) ranges, call stack §4.2).
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterator, Tuple
+
+
+class JsonlCorpus:
+    def __init__(self, path: str):
+        self.path = path
+        self._queries: list[str] = []
+        self._pages: list[str] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                self._queries.append(rec.get("query", ""))
+                self._pages.append(rec["page"])
+        if not self._pages:
+            raise ValueError(f"empty corpus: {path}")
+
+    @property
+    def num_pages(self) -> int:
+        return len(self._pages)
+
+    def page_text(self, i: int) -> str:
+        return self._pages[i]
+
+    def query_text(self, i: int) -> str:
+        return self._queries[i]
+
+    def pairs(self, start: int = 0, stop: int | None = None
+              ) -> Iterator[Tuple[int, str, str]]:
+        stop = self.num_pages if stop is None else min(stop, self.num_pages)
+        for i in range(start, stop):
+            yield i, self._queries[i], self._pages[i]
+
+    def all_texts(self, limit: int | None = None) -> Iterator[str]:
+        stop = self.num_pages if limit is None else min(limit, self.num_pages)
+        for i in range(stop):
+            yield self._pages[i]
+            if self._queries[i]:
+                yield self._queries[i]

@@ -1,0 +1,223 @@
+"""Batching + host->device prefetch (SURVEY.md §3 #4).
+
+The reference keeps tokenization and loading on the host feeding the
+accelerator (BASELINE.json:5). Here the hot principle is: nothing host-side
+may ever stall the jitted step. `prefetch_to_device` keeps `depth` batches
+already transferred (with their target NamedSharding, so each host only
+materialises its addressable shards) while the current step runs.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import queue as queue_mod
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from dnn_page_vectors_tpu.config import Config
+from dnn_page_vectors_tpu.data.jsonl import JsonlCorpus
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.data.trigram import TrigramTokenizer
+from dnn_page_vectors_tpu.data.words import WordTokenizer
+from dnn_page_vectors_tpu.data.subword import SubwordTokenizer
+
+Batch = Dict[str, np.ndarray]
+
+
+def build_corpus(cfg: Config):
+    d = cfg.data
+    if d.corpus == "toy":
+        return ToyCorpus(num_pages=d.num_pages, seed=d.seed,
+                         page_len=d.page_len, query_len=d.query_len)
+    if d.corpus.startswith("jsonl:"):
+        return JsonlCorpus(d.corpus[len("jsonl:"):])
+    raise ValueError(f"unknown corpus {d.corpus!r} (want 'toy' or 'jsonl:<path>')")
+
+
+def build_tokenizer(cfg: Config, corpus, cache_dir: Optional[str] = None):
+    """Builds (query_tok, page_tok). Trained vocabs (word/subword) are cached
+    under cache_dir so later embed/eval/mine runs reuse the EXACT vocab the
+    model was trained with — page vectors are only comparable across runs if
+    token ids are (vector-store reproducibility, SURVEY.md §3 #20)."""
+    d = cfg.data
+    if d.tokenizer == "trigram":   # stateless hashing: nothing to cache
+        q = TrigramTokenizer(d.trigram_buckets, max_words=d.query_len,
+                             k=d.trigrams_per_word)
+        p = TrigramTokenizer(d.trigram_buckets, max_words=d.page_len,
+                             k=d.trigrams_per_word)
+        return q, p
+    cache = (os.path.join(cache_dir, f"tokenizer_{d.tokenizer}.json")
+             if cache_dir else None)
+    if d.tokenizer == "word":
+        if cache and os.path.exists(cache):
+            tok = WordTokenizer.load(cache)
+        else:
+            tok = WordTokenizer.train(
+                corpus.all_texts(limit=min(corpus.num_pages, 20_000)),
+                vocab_size=d.vocab_size, max_words=d.page_len)
+            if cache:
+                tok.save(cache)
+        q = WordTokenizer(tok.vocab, max_words=d.query_len)
+        return q, tok
+    if d.tokenizer in ("wordpiece", "sentencepiece"):
+        if cache and os.path.exists(cache):
+            tok = SubwordTokenizer.load(cache)
+            tok.max_tokens = d.page_len
+        else:
+            tok = SubwordTokenizer.train(
+                corpus.all_texts(limit=min(corpus.num_pages, 5_000)),
+                vocab_size=min(d.vocab_size, 8_192), style=d.tokenizer,
+                max_tokens=d.page_len)
+            if cache:
+                tok.save(cache)
+        q = SubwordTokenizer(tok.vocab, style=tok.style, max_tokens=d.query_len)
+        return q, tok
+    raise ValueError(f"unknown tokenizer {d.tokenizer!r}")
+
+
+class TrainBatcher:
+    """Deterministic shuffled (query, page) training batches.
+
+    Yields {"query": [B, ...], "page": [B, ...], "page_id": [B]} numpy
+    batches; static shapes so the jitted step compiles once.
+    """
+
+    def __init__(self, corpus: ToyCorpus, query_tok, page_tok,
+                 batch_size: int, seed: int = 0, start_step: int = 0,
+                 hard_negative_lookup: Optional[Callable[[np.ndarray], np.ndarray]] = None):
+        if batch_size > corpus.num_pages:
+            raise ValueError(
+                f"batch_size {batch_size} > corpus size {corpus.num_pages}: "
+                "no full batch can ever be formed")
+        self.corpus = corpus
+        self.query_tok = query_tok
+        self.page_tok = page_tok
+        self.batch_size = batch_size
+        self.seed = seed
+        # resume point: global step -> (epoch, offset); makes a restored run
+        # continue the exact data order of an uninterrupted one (§5.4)
+        self.start_step = start_step
+        # maps [B] gold page ids -> [B, H] hard-negative page ids (mine/ann.py)
+        self.hard_negative_lookup = hard_negative_lookup
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.corpus.num_pages // self.batch_size
+
+    def __iter__(self) -> Iterator[Batch]:
+        n = self.corpus.num_pages
+        epoch = self.start_step // self.steps_per_epoch
+        skip = self.start_step % self.steps_per_epoch
+        while True:
+            rng = np.random.default_rng(self.seed + epoch)
+            order = rng.permutation(n)
+            for b in range(skip, self.steps_per_epoch):
+                s = b * self.batch_size
+                ids = order[s: s + self.batch_size]
+                yield self._materialize(ids)
+            skip = 0
+            epoch += 1
+
+    def _materialize(self, ids: np.ndarray) -> Batch:
+        queries = [self.corpus.query_text(int(i)) for i in ids]
+        pages = [self.corpus.page_text(int(i)) for i in ids]
+        batch: Batch = {
+            "query": self.query_tok.encode_batch(queries),
+            "page": self.page_tok.encode_batch(pages),
+            "page_id": ids.astype(np.int32),
+        }
+        if self.hard_negative_lookup is not None:
+            neg_ids = self.hard_negative_lookup(ids)  # [B, H]
+            flat = neg_ids.reshape(-1)
+            neg_pages = [self.corpus.page_text(int(i)) for i in flat]
+            enc = self.page_tok.encode_batch(neg_pages)
+            batch["neg_page"] = enc.reshape(neg_ids.shape + enc.shape[1:])
+        return batch
+
+
+def iter_corpus_batches(corpus: ToyCorpus, page_tok, batch_size: int,
+                        start: int = 0, stop: Optional[int] = None
+                        ) -> Iterator[Batch]:
+    """Fixed-order corpus sweep for bulk-embed; last batch is padded to keep
+    shapes static (pad rows flagged with page_id == -1)."""
+    stop = corpus.num_pages if stop is None else min(stop, corpus.num_pages)
+    for s in range(start, stop, batch_size):
+        ids = np.arange(s, min(s + batch_size, stop))
+        pages = [corpus.page_text(int(i)) for i in ids]
+        enc = page_tok.encode_batch(pages)
+        if len(ids) < batch_size:
+            pad = batch_size - len(ids)
+            enc = np.concatenate([enc, np.zeros((pad,) + enc.shape[1:], enc.dtype)])
+            ids = np.concatenate([ids, -np.ones(pad, dtype=ids.dtype)])
+        yield {"page": enc, "page_id": ids.astype(np.int32)}
+
+
+def prefetch_to_device(it: Iterator[Batch], sharding: Optional[Any] = None,
+                       depth: int = 2) -> Iterator[Any]:
+    """Double-buffered host->HBM pipeline.
+
+    A background thread tokenizes/materialises numpy batches; the consumer
+    side issues the (async) device_put so `depth` batches are in flight while
+    the TPU runs the current step. Producer exceptions re-raise in the
+    consumer (a swallowed tokenizer crash must not look like end-of-stream —
+    embed_corpus would record a short shard as complete). Abandoning the
+    generator (GeneratorExit) unblocks and stops the producer thread.
+    """
+    q: "queue_mod.Queue[Any]" = queue_mod.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+
+    def _producer() -> None:
+        try:
+            for item in it:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if stop.is_set():
+                    return
+            _finish(_END)
+        except BaseException as e:  # re-raised consumer-side
+            _finish(e)
+
+    def _finish(token: Any) -> None:
+        while not stop.is_set():
+            try:
+                q.put(token, timeout=0.1)
+                return
+            except queue_mod.Full:
+                continue
+
+    t = threading.Thread(target=_producer, daemon=True)
+    t.start()
+
+    buf: collections.deque[Any] = collections.deque()
+
+    def _put(batch: Batch) -> Any:
+        if sharding is None:
+            return jax.device_put(batch)
+        return jax.device_put(batch, jax.tree_util.tree_map(
+            lambda _: sharding, batch))
+
+    try:
+        while True:
+            while len(buf) < depth:
+                item = q.get()
+                if item is _END or isinstance(item, BaseException):
+                    break
+                buf.append(_put(item))
+            else:
+                yield buf.popleft()
+                continue
+            if isinstance(item, BaseException):
+                raise RuntimeError("prefetch producer failed") from item
+            while buf:  # producer finished cleanly: drain
+                yield buf.popleft()
+            return
+    finally:
+        stop.set()

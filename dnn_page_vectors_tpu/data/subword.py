@@ -1,0 +1,160 @@
+"""Subword tokenizers for the transformer towers (SURVEY.md §3 #3).
+
+BERT-mini wants a WordPiece-style vocabulary (BASELINE.json:9) and mT5 a
+SentencePiece-style one (BASELINE.json:11). The sandbox has no network to
+fetch the published vocab files, so both surface forms run over one
+self-contained, deterministic BPE core trained on the corpus:
+
+  * style="wordpiece":      pieces inside a word are prefixed "##" (BERT).
+  * style="sentencepiece":  word-initial pieces are prefixed "▁" (T5/mT5).
+
+The trainer is classic BPE (greedy highest-count pair merge, deterministic
+tie-break by pair ordering); encoding is greedy longest-match, which matches
+WordPiece inference and is a close, deterministic stand-in for unigram-LM
+sampling-free SentencePiece inference.
+"""
+from __future__ import annotations
+
+import collections
+import json
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+PAD_ID = 0
+UNK_ID = 1
+_RESERVED = 2
+_WORD_BOUNDARY = "▁"  # ▁
+
+
+def _train_bpe(word_counts: Dict[Tuple[str, ...], int], num_merges: int
+               ) -> List[Tuple[str, str]]:
+    """Greedy BPE merge learning over symbol-tuple word counts."""
+    merges: List[Tuple[str, str]] = []
+    words = dict(word_counts)
+    for _ in range(num_merges):
+        pair_counts: collections.Counter[Tuple[str, str]] = collections.Counter()
+        for sym, c in words.items():
+            for a, b in zip(sym, sym[1:]):
+                pair_counts[(a, b)] += c
+        if not pair_counts:
+            break
+        # deterministic: highest count, then lexicographic pair
+        best = min(pair_counts.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+        if pair_counts[best] < 2:
+            break
+        merges.append(best)
+        merged = best[0] + best[1]
+        new_words: Dict[Tuple[str, ...], int] = {}
+        for sym, c in words.items():
+            out: List[str] = []
+            i = 0
+            while i < len(sym):
+                if i + 1 < len(sym) and sym[i] == best[0] and sym[i + 1] == best[1]:
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(sym[i])
+                    i += 1
+            new_words[tuple(out)] = new_words.get(tuple(out), 0) + c
+        words = new_words
+    return merges
+
+
+class SubwordTokenizer:
+    """BPE-core subword tokenizer with WordPiece / SentencePiece surfaces."""
+
+    def __init__(self, vocab: Dict[str, int], style: str = "wordpiece",
+                 max_tokens: int = 64):
+        assert style in ("wordpiece", "sentencepiece"), style
+        self.vocab = vocab
+        self.style = style
+        self.max_tokens = max_tokens
+
+    # -- training ---------------------------------------------------------
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int = 8_192,
+              style: str = "wordpiece", max_tokens: int = 64,
+              max_train_words: int = 2_000_000) -> "SubwordTokenizer":
+        counts: collections.Counter[str] = collections.Counter()
+        seen = 0
+        for text in texts:
+            ws = text.split()
+            counts.update(ws)
+            seen += len(ws)
+            if seen >= max_train_words:
+                break
+        word_counts = {tuple(w): c for w, c in counts.items()}
+        alphabet = sorted({ch for w in word_counts for ch in w})
+        num_merges = max(0, vocab_size - len(alphabet) - _RESERVED)
+        merges = _train_bpe(word_counts, num_merges)
+        pieces = list(alphabet) + [a + b for a, b in merges]
+        # piece -> id, longest pieces preferred implicitly by greedy matcher
+        vocab = {p: i + _RESERVED for i, p in enumerate(dict.fromkeys(pieces))}
+        return cls(vocab, style=style, max_tokens=max_tokens)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab) + _RESERVED
+
+    # -- encoding ---------------------------------------------------------
+    def _encode_word(self, word: str) -> List[int]:
+        """Greedy longest-match over the BPE vocab."""
+        ids: List[int] = []
+        i = 0
+        n = len(word)
+        while i < n:
+            j = n
+            while j > i:
+                piece = word[i:j]
+                if piece in self.vocab:
+                    ids.append(self.vocab[piece])
+                    break
+                j -= 1
+            else:
+                ids.append(UNK_ID)
+                j = i + 1
+            i = j
+        return ids
+
+    def encode(self, text: str) -> np.ndarray:
+        out = np.zeros(self.max_tokens, dtype=np.int32)
+        pos = 0
+        for word in text.split():
+            if pos >= self.max_tokens:
+                break
+            for tid in self._encode_word(word):
+                if pos >= self.max_tokens:
+                    break
+                out[pos] = tid
+                pos += 1
+        return out
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self.encode(t) for t in texts])
+
+    def tokens(self, text: str) -> List[str]:
+        """Human-readable pieces with style-appropriate decoration (debug/tests)."""
+        inv = {v: k for k, v in self.vocab.items()}
+        out: List[str] = []
+        for word in text.split():
+            for wi, tid in enumerate(self._encode_word(word)):
+                piece = inv.get(tid, "<unk>")
+                if self.style == "wordpiece":
+                    out.append(piece if wi == 0 else "##" + piece)
+                else:
+                    out.append((_WORD_BOUNDARY + piece) if wi == 0 else piece)
+        return out
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"style": self.style, "max_tokens": self.max_tokens,
+                       "vocab": self.vocab}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "SubwordTokenizer":
+        with open(path) as f:
+            blob = json.load(f)
+        return cls(blob["vocab"], style=blob["style"],
+                   max_tokens=blob["max_tokens"])

@@ -1,0 +1,68 @@
+"""Char-trigram hashing tokenizer (CDSSM-style; SURVEY.md §3 #1).
+
+The classic CDSSM letter-trigram representation is a ~30k-dim count vector
+per word. That layout wastes MXU cycles on TPU; instead each word is encoded
+as up to K hashed trigram ids and the encoder sums their embeddings
+(embedding-bag), which is a dense [B, L, K] gather + reduction XLA maps onto
+the MXU-friendly path. Output ids are 1..buckets with 0 reserved for padding.
+
+Hashing is FNV-1a — stable across processes/runs (Python's builtin hash() is
+salted and would break vector-store reproducibility). If the optional C++
+fast path (dnn_page_vectors_tpu.native) has been built, encode() dispatches
+to it; otherwise the pure-Python loop below runs.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h
+
+
+def word_trigrams(word: str) -> List[str]:
+    padded = f"#{word}#"
+    if len(padded) < 3:
+        return [padded]
+    return [padded[i:i + 3] for i in range(len(padded) - 2)]
+
+
+class TrigramTokenizer:
+    """text -> int32 ids of shape [max_words, k] (0 = pad)."""
+
+    def __init__(self, buckets: int = 16_384, max_words: int = 64, k: int = 8):
+        self.buckets = buckets
+        self.max_words = max_words
+        self.k = k
+        self._native = None
+        try:  # optional C++ fast path; pure-Python fallback below
+            from dnn_page_vectors_tpu.native import trigram_native
+            self._native = trigram_native
+        except Exception:
+            self._native = None
+
+    @property
+    def vocab_size(self) -> int:
+        return self.buckets + 1  # + padding id 0
+
+    def encode(self, text: str) -> np.ndarray:
+        if self._native is not None:
+            return self._native.encode(text, self.buckets, self.max_words, self.k)
+        out = np.zeros((self.max_words, self.k), dtype=np.int32)
+        for wi, word in enumerate(text.split()[: self.max_words]):
+            tgs = word_trigrams(word)[: self.k]
+            for ti, tg in enumerate(tgs):
+                out[wi, ti] = 1 + fnv1a(tg.encode("utf-8")) % self.buckets
+        return out
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self.encode(t) for t in texts])

@@ -1,0 +1,56 @@
+"""Word-level tokenizer + frequency vocab (Kim-CNN input; SURVEY.md §3 #2)."""
+from __future__ import annotations
+
+import collections
+import json
+from typing import Iterable, Sequence
+
+import numpy as np
+
+PAD_ID = 0
+UNK_ID = 1
+_RESERVED = 2
+
+
+class WordTokenizer:
+    """Most-frequent-N word vocab; text -> int32 ids [max_words] (0 pad, 1 unk)."""
+
+    def __init__(self, vocab: dict[str, int], max_words: int = 64):
+        self.vocab = vocab
+        self.max_words = max_words
+
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int = 30_000,
+              max_words: int = 64) -> "WordTokenizer":
+        counts: collections.Counter[str] = collections.Counter()
+        for text in texts:
+            counts.update(text.split())
+        # deterministic: sort by (-count, word)
+        ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        vocab = {w: i + _RESERVED for i, (w, _) in
+                 enumerate(ranked[: vocab_size - _RESERVED])}
+        return cls(vocab, max_words=max_words)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab) + _RESERVED
+
+    def encode(self, text: str) -> np.ndarray:
+        out = np.zeros(self.max_words, dtype=np.int32)
+        for i, w in enumerate(text.split()[: self.max_words]):
+            out[i] = self.vocab.get(w, UNK_ID)
+        return out
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self.encode(t) for t in texts])
+
+    # -- persistence (vector-store reproducibility needs a stable vocab) ----
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"max_words": self.max_words, "vocab": self.vocab}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "WordTokenizer":
+        with open(path) as f:
+            blob = json.load(f)
+        return cls(blob["vocab"], max_words=blob["max_words"])

@@ -1,0 +1,4 @@
+"""Eval layer: Recall@K query->page retrieval (SURVEY.md §2 layer 6)."""
+from dnn_page_vectors_tpu.evals.recall import recall_at_k, evaluate_recall
+
+__all__ = ["recall_at_k", "evaluate_recall"]

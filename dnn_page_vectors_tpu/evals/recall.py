@@ -1,0 +1,54 @@
+"""Retrieval eval: Recall@10 query->page (SURVEY.md §3 #22; BASELINE.json:2).
+
+Shares the chunked on-device top-k kernel with the ANN miner (call stack
+§4.3): scores = Q @ P.T on the MXU, running top-k via lax.scan, host-side
+comparison against gold labels.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.ops.topk import chunked_topk
+
+
+def recall_at_k(query_vecs: np.ndarray, page_ids: np.ndarray,
+                page_vecs: np.ndarray, gold_ids: np.ndarray,
+                k: int = 10, query_batch: int = 1024,
+                chunk: int = 8192) -> float:
+    """Fraction of queries whose gold page id is in the top-k.
+
+    query_vecs [Nq, D] and page_vecs [N, D] must be L2-normalized (the
+    store's invariant); page_ids maps store rows -> page ids.
+    """
+    hits = 0
+    nq = query_vecs.shape[0]
+    pages = jnp.asarray(page_vecs, jnp.float32)
+    for s in range(0, nq, query_batch):
+        q = jnp.asarray(query_vecs[s: s + query_batch], jnp.float32)
+        _, idx = chunked_topk(q, pages, k=k, chunk=chunk)
+        idx = np.asarray(idx)
+        # -1 padding (store smaller than k) must not wrap to the last row
+        retrieved = np.where(idx >= 0, page_ids[np.clip(idx, 0, None)], -1)
+        gold = gold_ids[s: s + query_batch, None]
+        hits += int((retrieved == gold).any(axis=1).sum())
+    return hits / max(nq, 1)
+
+
+def evaluate_recall(embedder: BulkEmbedder, corpus: ToyCorpus,
+                    store: VectorStore, num_queries: Optional[int] = None,
+                    k: int = 10) -> Tuple[float, int]:
+    """Embed eval queries, search the store, return (recall@k, num_queries).
+    Gold label for query i is page i (ToyCorpus invariant)."""
+    nq = min(num_queries or embedder.cfg.eval.eval_queries, corpus.num_pages)
+    query_vecs = embedder.embed_texts(
+        [corpus.query_text(i) for i in range(nq)], tower="query")
+    page_ids, page_vecs = store.load_all()
+    gold = np.arange(nq, dtype=np.int64)
+    r = recall_at_k(query_vecs, page_ids, page_vecs, gold, k=k)
+    return r, nq

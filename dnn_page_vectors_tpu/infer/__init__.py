@@ -1,0 +1,6 @@
+"""Inference layer: sharded corpus->vector bulk-embed job + vector store
+(SURVEY.md §2 layer 5, §3 #19-20)."""
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+
+__all__ = ["BulkEmbedder", "VectorStore"]

@@ -1,0 +1,119 @@
+"""Corpus->vector bulk-embed job (SURVEY.md §3 #19; call stack §4.2).
+
+The reference's batch-inference job ran data-parallel on GPUs
+(BASELINE.json:5); here the forward pass is one jitted `encode_page` with
+the batch sharded over the mesh 'data' axis and params HBM-resident, so every
+chip embeds its batch shard and results stream back to the host (overlapped
+with the next batch via the prefetch queue) into the resumable vector store.
+Throughput metric: pages/sec/chip (BASELINE.json:2).
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnn_page_vectors_tpu.config import Config
+from dnn_page_vectors_tpu.data.loader import iter_corpus_batches, prefetch_to_device
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.models.losses import l2_normalize
+from dnn_page_vectors_tpu.parallel.sharding import (
+    batch_sharding, replicated, shard_params)
+from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+
+
+class BulkEmbedder:
+    def __init__(self, cfg: Config, model, params, page_tok, mesh,
+                 query_tok=None):
+        self.cfg = cfg
+        self.model = model
+        # (re-)place params for THIS mesh — training may have run on a
+        # different mesh shape than the embed job (call stack §4.2 restores
+        # from checkpoint anyway).
+        self.params = shard_params(params, mesh)
+        self.page_tok = page_tok
+        self.query_tok = query_tok
+        self.mesh = mesh
+        out_sh = batch_sharding(mesh)
+
+        def _encode(params, ids, method):
+            vecs = model.apply(params, ids, deterministic=True, method=method)
+            return l2_normalize(vecs)
+
+        self._encode_page = jax.jit(
+            lambda p, x: _encode(p, x, "encode_page"),
+            in_shardings=(None, batch_sharding(mesh)), out_shardings=out_sh)
+        self._encode_query = jax.jit(
+            lambda p, x: _encode(p, x, "encode_query"),
+            in_shardings=(None, batch_sharding(mesh)), out_shardings=out_sh)
+
+    # -- single batches ---------------------------------------------------
+    def embed_pages(self, ids: np.ndarray) -> np.ndarray:
+        return np.asarray(self._encode_page(self.params, ids))
+
+    def embed_queries(self, ids: np.ndarray) -> np.ndarray:
+        return np.asarray(self._encode_query(self.params, ids))
+
+    def embed_texts(self, texts, tower: str = "query",
+                    batch_size: Optional[int] = None) -> np.ndarray:
+        """Tokenize + embed a list of texts, padding each batch to the
+        compiled batch shape (one XLA program regardless of len(texts)).
+        Shared by the recall eval and the ANN miner."""
+        tok = self.query_tok if tower == "query" else self.page_tok
+        run = self.embed_queries if tower == "query" else self.embed_pages
+        bs = batch_size or self.cfg.eval.embed_batch_size
+        chunks = []
+        for s in range(0, len(texts), bs):
+            part = texts[s: s + bs]
+            enc = tok.encode_batch(part)
+            if enc.shape[0] < bs:
+                pad = bs - enc.shape[0]
+                enc = np.concatenate(
+                    [enc, np.zeros((pad,) + enc.shape[1:], enc.dtype)])
+            chunks.append(run(enc)[: len(part)])
+        return (np.concatenate(chunks) if chunks
+                else np.zeros((0, self.cfg.model.out_dim), np.float32))
+
+    # -- the bulk job -----------------------------------------------------
+    def embed_corpus(self, corpus: ToyCorpus, store: VectorStore,
+                     batch_size: Optional[int] = None, resume: bool = True,
+                     log: Optional[MetricsLogger] = None) -> VectorStore:
+        """Sweep the corpus into the store, one store-shard at a time.
+
+        Resume: completed shards are recorded in the store manifest and
+        skipped on restart (SURVEY.md §5.3 fault recovery).
+        """
+        bs = batch_size or self.cfg.eval.embed_batch_size
+        shard_size = store.manifest["shard_size"]
+        assert shard_size % bs == 0 or shard_size >= corpus.num_pages, (
+            "shard_size must be a batch multiple for resumable sweeps")
+        n_shards = -(-corpus.num_pages // shard_size)
+        done = store.completed_shards() if resume else set()
+        n_dev = self.mesh.devices.size
+        t0 = time.perf_counter()
+        pages = 0
+        for si in range(n_shards):
+            if si in done:
+                continue
+            start = si * shard_size
+            stop = min(start + shard_size, corpus.num_pages)
+            ids_acc, vec_acc = [], []
+            batches = iter_corpus_batches(corpus, self.page_tok, bs,
+                                          start=start, stop=stop)
+            for batch in prefetch_to_device(batches,
+                                            sharding=batch_sharding(self.mesh)):
+                vecs = self._encode_page(self.params, batch["page"])
+                ids_acc.append(np.asarray(batch["page_id"]))
+                vec_acc.append(np.asarray(vecs))
+                pages += int((ids_acc[-1] >= 0).sum())
+            store.write_shard(si, np.concatenate(ids_acc),
+                              np.concatenate(vec_acc))
+            if log:
+                dt = time.perf_counter() - t0
+                log.write({"bulk_embed_shard": si,
+                           "pages_per_sec_per_chip": pages / dt / n_dev})
+        return store

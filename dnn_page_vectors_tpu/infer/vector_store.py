@@ -1,0 +1,118 @@
+"""Vector store: memory-mapped fp16 shards + id index (SURVEY.md §3 #20).
+
+Layout under a directory:
+  manifest.json               {"dim", "dtype", "shard_size", "shards": [...]}
+  shard_00000.vec.npy         [n, dim] float16 L2-NORMALIZED page vectors
+  shard_00000.ids.npy         [n] int64 page ids  (-1 = padding, never stored)
+
+Vectors are stored normalized so retrieval is a pure dot product. Shards are
+the resume unit: the manifest records completed shards and a restarted job
+skips them (SURVEY.md §5.3 failure recovery).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class VectorStore:
+    def __init__(self, directory: str, dim: int | None = None,
+                 shard_size: int = 65_536):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._manifest_path = os.path.join(self.directory, "manifest.json")
+        if os.path.exists(self._manifest_path):
+            with open(self._manifest_path) as f:
+                self.manifest = json.load(f)
+            if dim is not None and dim != self.manifest["dim"]:
+                raise ValueError(
+                    f"store at {self.directory} holds {self.manifest['dim']}-d "
+                    f"vectors but dim={dim} was requested; use a fresh "
+                    "directory (or reset()) when the model out_dim changes")
+        else:
+            if dim is None:
+                raise FileNotFoundError(
+                    f"no vector store at {self.directory} (missing "
+                    "manifest.json) — run the 'embed' job first, or pass "
+                    "dim= to create a new store")
+            self.manifest = {"dim": dim, "dtype": "float16",
+                             "shard_size": shard_size, "shards": []}
+            self._flush_manifest()
+
+    @property
+    def dim(self) -> int:
+        return self.manifest["dim"]
+
+    @property
+    def num_vectors(self) -> int:
+        return sum(s["count"] for s in self.manifest["shards"])
+
+    def completed_shards(self) -> set:
+        return {s["index"] for s in self.manifest["shards"]}
+
+    def _flush_manifest(self) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._manifest_path)  # atomic: crash-safe resume
+
+    def reset(self) -> None:
+        """Drop all shards (e.g. the model changed and vectors are stale)."""
+        for s in self.manifest["shards"]:
+            for key in ("vec", "ids"):
+                try:
+                    os.remove(os.path.join(self.directory, s[key]))
+                except FileNotFoundError:
+                    pass
+        self.manifest["shards"] = []
+        self._flush_manifest()
+
+    # -- write ------------------------------------------------------------
+    def write_shard(self, index: int, ids: np.ndarray,
+                    vecs: np.ndarray) -> None:
+        if vecs.shape[-1] != self.dim:
+            raise ValueError(f"vectors are {vecs.shape[-1]}-d, store is "
+                             f"{self.dim}-d")
+        keep = ids >= 0  # drop batch padding rows
+        ids, vecs = ids[keep], vecs[keep]
+        vpath = os.path.join(self.directory, f"shard_{index:05d}.vec.npy")
+        ipath = os.path.join(self.directory, f"shard_{index:05d}.ids.npy")
+        np.save(vpath, vecs.astype(np.float16))
+        np.save(ipath, ids.astype(np.int64))
+        entry = {"index": index, "count": int(ids.shape[0]),
+                 "vec": os.path.basename(vpath), "ids": os.path.basename(ipath)}
+        self.manifest["shards"] = (
+            [s for s in self.manifest["shards"] if s["index"] != index]
+            + [entry])
+        self.manifest["shards"].sort(key=lambda s: s["index"])
+        self._flush_manifest()
+
+    # -- read -------------------------------------------------------------
+    def load_shard(self, index: int) -> Tuple[np.ndarray, np.ndarray]:
+        entry = {s["index"]: s for s in self.manifest["shards"]}[index]
+        vecs = np.load(os.path.join(self.directory, entry["vec"]),
+                       mmap_mode="r")
+        ids = np.load(os.path.join(self.directory, entry["ids"]))
+        return ids, vecs
+
+    def load_all(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Concatenated (ids [N], vectors [N, D] fp16). Shard files are
+        memory-mapped; the concat materialises — callers at 1B-page scale
+        should iterate shards instead (see iter_shards)."""
+        ids_list: List[np.ndarray] = []
+        vec_list: List[np.ndarray] = []
+        for s in self.manifest["shards"]:
+            ids, vecs = self.load_shard(s["index"])
+            ids_list.append(ids)
+            vec_list.append(np.asarray(vecs))
+        if not ids_list:
+            return (np.zeros(0, np.int64),
+                    np.zeros((0, self.dim), np.float16))
+        return np.concatenate(ids_list), np.concatenate(vec_list)
+
+    def iter_shards(self):
+        for s in self.manifest["shards"]:
+            yield self.load_shard(s["index"])

@@ -1,0 +1,4 @@
+"""Hard-negative mining layer (SURVEY.md §2 layer 6, §3 #21)."""
+from dnn_page_vectors_tpu.mine.ann import HardNegatives, mine_hard_negatives
+
+__all__ = ["HardNegatives", "mine_hard_negatives"]

@@ -1,0 +1,89 @@
+"""ANN hard-negative miner (SURVEY.md §3 #21; BASELINE.json:10; call stack §4.4).
+
+The reference mined hard negatives with an ANN index over the embedded
+corpus. The TPU-native path is exact brute-force retrieval on the MXU: embed
+queries with the current params, stream the vector store through the chunked
+top-k kernel (ops/topk.py), drop the gold page, keep the top H as negatives.
+Mined lists feed back into training via TrainBatcher.hard_negative_lookup
+(the mine -> train loop of config 4).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.ops.topk import chunked_topk
+
+import jax.numpy as jnp
+
+
+class HardNegatives:
+    """[num_queries, H] page-id table; callable for TrainBatcher."""
+
+    def __init__(self, table: np.ndarray):
+        assert table.ndim == 2
+        self.table = table.astype(np.int32)
+
+    @property
+    def num_negatives(self) -> int:
+        return self.table.shape[1]
+
+    def __call__(self, gold_ids: np.ndarray) -> np.ndarray:
+        if int(np.max(gold_ids)) >= self.table.shape[0]:
+            raise ValueError(
+                f"hard-negative table covers page ids < {self.table.shape[0]} "
+                f"but batch contains id {int(np.max(gold_ids))}; mine over the "
+                "full training corpus (num_queries=None) before training")
+        return self.table[gold_ids]
+
+    def save(self, path: str) -> None:
+        np.save(path, self.table)
+
+    @classmethod
+    def load(cls, path: str) -> "HardNegatives":
+        return cls(np.load(path))
+
+
+def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
+                        store: VectorStore, num_negatives: int = 7,
+                        search_k: int = 100,
+                        num_queries: Optional[int] = None) -> HardNegatives:
+    """Top-`search_k` retrieval per training query minus the gold page,
+    truncated to `num_negatives`. Queries are embedded with CURRENT params
+    (periodic re-mining keeps negatives hard as the model improves)."""
+    nq = min(num_queries or corpus.num_pages, corpus.num_pages)
+    if corpus.num_pages < 2:
+        raise ValueError("cannot mine negatives from a <2-page corpus")
+    page_ids, page_vecs = store.load_all()
+    pages = jnp.asarray(np.asarray(page_vecs), jnp.float32)
+    bs = embedder.cfg.eval.embed_batch_size
+    k = min(search_k, page_ids.shape[0])
+    out = np.zeros((nq, num_negatives), dtype=np.int32)
+    for s in range(0, nq, bs):
+        idx = list(range(s, min(s + bs, nq)))
+        qvecs = embedder.embed_texts(
+            [corpus.query_text(i) for i in idx], tower="query")
+        _, top = chunked_topk(jnp.asarray(qvecs, jnp.float32), pages,
+                              k=k)
+        top = np.asarray(top)
+        # -1 slots (store smaller than k) must not wrap to the last row
+        retrieved = np.where(top >= 0, page_ids[np.clip(top, 0, None)], -1)
+        for r, qi in enumerate(idx):
+            negs = [int(p) for p in retrieved[r]
+                    if p != qi and p >= 0][: num_negatives]
+            # tiny corpora: deterministic fillers — never the gold page,
+            # unique until the corpus is exhausted, then cycled
+            off = 1
+            while len(negs) < num_negatives:
+                cand = (qi + off) % corpus.num_pages
+                if cand != qi and (cand not in negs
+                                   or off > corpus.num_pages):
+                    negs.append(cand)
+                off += 1
+            out[qi] = negs
+    return HardNegatives(out)

@@ -1,0 +1,11 @@
+"""Model layer: encoder zoo + two-tower wrapper + contrastive losses.
+
+Every encoder maps token ids -> a [B, out_dim] page/query vector and is a
+pure flax module: `init` / `apply` only, static shapes, compute dtype
+bfloat16 so matmuls and convs land on the MXU (SURVEY.md §2 layer 2).
+"""
+from dnn_page_vectors_tpu.models.factory import build_two_tower
+from dnn_page_vectors_tpu.models.two_tower import TwoTower
+from dnn_page_vectors_tpu.models.losses import cosine_contrastive_loss
+
+__all__ = ["build_two_tower", "TwoTower", "cosine_contrastive_loss"]

@@ -1,0 +1,43 @@
+"""CDSSM char-trigram Conv1D encoder (SURVEY.md §3 #5; BASELINE.json:5,7).
+
+The classic CDSSM feeds a ~30k-dim letter-trigram count vector per word into
+a Conv1D. On TPU that sparse one-hot layout is hostile to the MXU, so the
+trigram hash ids [B, L, K] are embedded and summed per word (embedding-bag —
+a dense gather+reduce XLA handles well), then a word-window Conv1D + tanh +
+masked global max-pool + projection produce the page/query vector, which is
+the same function the reference computes.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CdssmEncoder(nn.Module):
+    vocab_size: int            # trigram hash buckets + 1 (0 = pad)
+    embed_dim: int = 128
+    conv_width: int = 3
+    conv_channels: int = 256
+    out_dim: int = 128
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        # ids: [B, L, K] hashed trigram ids, 0 = pad.
+        tg_mask = (ids > 0).astype(self.dtype)[..., None]          # [B, L, K, 1]
+        emb = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
+                       name="trigram_embed")(ids)                  # [B, L, K, E]
+        word = (emb * tg_mask).sum(axis=2)                         # [B, L, E]
+        word_mask = (ids > 0).any(axis=-1)                         # [B, L]
+
+        h = nn.Conv(self.conv_channels, kernel_size=(self.conv_width,),
+                    padding="SAME", dtype=self.dtype, name="conv")(word)
+        h = jnp.tanh(h)                                            # [B, L, C]
+        neg_inf = jnp.asarray(-1e9, self.dtype)
+        h = jnp.where(word_mask[..., None], h, neg_inf)
+        pooled = h.max(axis=1)                                     # [B, C]
+        # all-pad rows (empty text) pool to -1e9; zero them out
+        any_word = word_mask.any(axis=1, keepdims=True)
+        pooled = jnp.where(any_word, pooled, jnp.zeros_like(pooled))
+        out = nn.Dense(self.out_dim, dtype=self.dtype, name="proj")(pooled)
+        return jnp.tanh(out).astype(jnp.float32)                   # [B, D]

@@ -1,0 +1,48 @@
+"""Encoder-zoo factory: ModelConfig -> TwoTower module (SURVEY.md §3 #5-9)."""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dnn_page_vectors_tpu.config import Config
+from dnn_page_vectors_tpu.models.cdssm import CdssmEncoder
+from dnn_page_vectors_tpu.models.kim_cnn import KimCnnEncoder
+from dnn_page_vectors_tpu.models.transformer import TransformerEncoder
+from dnn_page_vectors_tpu.models.two_tower import TwoTower
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+def _build_encoder(cfg: Config, vocab_size: int, name: str) -> nn.Module:
+    m = cfg.model
+    dtype = _DTYPES[m.dtype]
+    if m.encoder == "cdssm":
+        return CdssmEncoder(vocab_size=vocab_size, embed_dim=m.embed_dim,
+                            conv_width=m.conv_widths[0],
+                            conv_channels=m.conv_channels, out_dim=m.out_dim,
+                            dtype=dtype, name=name)
+    if m.encoder == "kim_cnn":
+        return KimCnnEncoder(vocab_size=vocab_size, embed_dim=m.embed_dim,
+                             conv_widths=m.conv_widths,
+                             conv_channels=m.conv_channels, out_dim=m.out_dim,
+                             dropout=m.dropout, dtype=dtype, name=name)
+    if m.encoder in ("bert", "t5"):
+        max_len = max(cfg.data.query_len, cfg.data.page_len)
+        return TransformerEncoder(vocab_size=vocab_size,
+                                  num_layers=m.num_layers,
+                                  num_heads=m.num_heads,
+                                  model_dim=m.model_dim, mlp_dim=m.mlp_dim,
+                                  out_dim=m.out_dim, max_len=max_len,
+                                  dropout=m.dropout, variant=m.encoder,
+                                  dtype=dtype, name=name)
+    raise ValueError(f"unknown encoder {cfg.model.encoder!r}")
+
+
+def build_two_tower(cfg: Config, vocab_size: int) -> TwoTower:
+    """Both towers share one tokenizer vocab (query/page differ only in
+    length), so one vocab_size parameterises both."""
+    query_tower = _build_encoder(cfg, vocab_size, "query_tower")
+    page_tower = _build_encoder(cfg, vocab_size, "page_tower")
+    return TwoTower(query_tower=query_tower, page_tower=page_tower,
+                    shared=cfg.model.shared_towers,
+                    temperature_init=cfg.train.temperature_init)

@@ -1,0 +1,44 @@
+"""Kim-CNN word-level encoder (SURVEY.md §3 #6; BASELINE.json:8).
+
+Multi-width Conv1D banks over word embeddings with masked global max-pool
+and concatenation — the Kim (2014) text-CNN shape. All conv widths run as
+separate `nn.Conv`s over the same [B, L, E] activations; XLA fuses the
+elementwise tails and keeps the convs on the MXU.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class KimCnnEncoder(nn.Module):
+    vocab_size: int
+    embed_dim: int = 256
+    conv_widths: Tuple[int, ...] = (3, 4, 5)
+    conv_channels: int = 256
+    out_dim: int = 256
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        # ids: [B, L] word ids, 0 = pad.
+        mask = ids > 0                                             # [B, L]
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
+                     name="word_embed")(ids)                       # [B, L, E]
+        neg_inf = jnp.asarray(-1e9, self.dtype)
+        pools = []
+        for w in self.conv_widths:
+            h = nn.Conv(self.conv_channels, kernel_size=(w,), padding="SAME",
+                        dtype=self.dtype, name=f"conv{w}")(x)
+            h = nn.relu(h)
+            h = jnp.where(mask[..., None], h, neg_inf)
+            pools.append(h.max(axis=1))                            # [B, C]
+        h = jnp.concatenate(pools, axis=-1)                        # [B, C * n]
+        any_word = mask.any(axis=1, keepdims=True)
+        h = jnp.where(any_word, h, jnp.zeros_like(h))
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        out = nn.Dense(self.out_dim, dtype=self.dtype, name="proj")(h)
+        return out.astype(jnp.float32)                             # [B, D]

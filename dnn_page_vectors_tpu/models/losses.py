@@ -1,0 +1,61 @@
+"""Cosine-contrastive loss with global in-batch + ANN-mined hard negatives
+(SURVEY.md §3 #10; BASELINE.json:5,9,10).
+
+TPU-first note on distribution: this loss is written as *global-batch* math.
+Under jit with the batch sharded over the mesh 'data' axis, the q @ p.T
+similarity needs every page vector on every shard, so GSPMD inserts the
+all-gather (and the corresponding reduce-scatter in the backward pass) over
+ICI automatically — the gradient-correct global in-batch negatives that
+torch-DDP's NCCL hooks provided the reference (SURVEY.md §7 "hard parts")
+fall out of the partitioner with no user collective code.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def l2_normalize(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    x = x.astype(jnp.float32)
+    return x * jax.lax.rsqrt((x * x).sum(-1, keepdims=True) + eps)
+
+
+def cosine_contrastive_loss(
+    q: jnp.ndarray,                       # [B, D] query vectors
+    p: jnp.ndarray,                       # [B, D] gold page vectors
+    scale: jnp.ndarray,                   # scalar inverse temperature
+    neg: Optional[jnp.ndarray] = None,    # [B, H, D] mined hard negatives
+    symmetric: bool = True,
+) -> Tuple[jnp.ndarray, dict]:
+    """Softmax contrastive loss over cosine similarities.
+
+    Row i's positives are the diagonal; its negatives are every other
+    in-batch page (global batch under GSPMD) plus, if given, all B*H mined
+    hard negatives. `symmetric=True` adds the page->query direction (only
+    over the in-batch block — mined negatives have no query side).
+    """
+    qn = l2_normalize(q)
+    pn = l2_normalize(p)
+    logits = scale * (qn @ pn.T)                                   # [B, B]
+    if neg is not None:
+        B = q.shape[0]
+        nn_ = l2_normalize(neg.reshape(-1, neg.shape[-1]))         # [B*H, D]
+        extra = scale * (qn @ nn_.T)                               # [B, B*H]
+        logits_qp = jnp.concatenate([logits, extra], axis=1)       # [B, B+BH]
+    else:
+        logits_qp = logits
+    labels = jnp.arange(q.shape[0])
+    loss_qp = optax.softmax_cross_entropy_with_integer_labels(
+        logits_qp, labels).mean()
+    if symmetric:
+        loss_pq = optax.softmax_cross_entropy_with_integer_labels(
+            logits.T, labels).mean()
+        loss = 0.5 * (loss_qp + loss_pq)
+    else:
+        loss = loss_qp
+    in_batch_acc = (logits_qp.argmax(axis=1) == labels).mean()
+    return loss, {"loss": loss, "in_batch_acc": in_batch_acc,
+                  "scale": scale}

@@ -1,0 +1,162 @@
+"""Transformer encoder shared by the BERT-mini and mT5 towers
+(SURVEY.md §3 #7-8; BASELINE.json:9,11).
+
+One implementation, two variants:
+  * variant="bert" — learned absolute positions, LayerNorm, GELU MLP
+    (BERT-mini geometry: L=4, d=256, A=4).
+  * variant="t5"   — T5 relative-position buckets shared across layers,
+    RMSNorm, gated-GELU MLP, no biases (mT5-base encoder geometry:
+    L=12, d=768, A=12, ff=2048).
+
+TPU-first choices: pre-norm blocks (stable in bfloat16), softmax in float32,
+everything else bfloat16 on the MXU, static [B, L] shapes, no Python control
+flow dependent on data. Attention/MLP matmul dims are the tensor-parallel
+('model' mesh axis) sharding surface — see parallel/sharding.py rules keyed
+on the param names used here (wq/wk/wv/wo, wi/wi_0/wi_1/wo_mlp).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _relative_position_bucket(rel_pos: jnp.ndarray, num_buckets: int = 32,
+                              max_distance: int = 128) -> jnp.ndarray:
+    """T5 bidirectional relative-position bucketing."""
+    num_buckets //= 2
+    ret = (rel_pos > 0).astype(jnp.int32) * num_buckets
+    n = jnp.abs(rel_pos)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class RmsNorm(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + 1e-6)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        return (y * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    num_heads: int
+    model_dim: int
+    use_bias: bool
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, pad_mask: jnp.ndarray,
+                 rel_bias: jnp.ndarray | None) -> jnp.ndarray:
+        head_dim = self.model_dim // self.num_heads
+        dense = lambda name: nn.Dense(self.model_dim, use_bias=self.use_bias,
+                                      dtype=self.dtype, name=name)
+        B, L, _ = x.shape
+        shape = (B, L, self.num_heads, head_dim)
+        q = dense("wq")(x).reshape(shape)
+        k = dense("wk")(x).reshape(shape)
+        v = dense("wv")(x).reshape(shape)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        scores = scores.astype(jnp.float32)
+        if rel_bias is not None:
+            scores = scores + rel_bias
+        big_neg = jnp.asarray(-1e9, jnp.float32)
+        scores = jnp.where(pad_mask[:, None, None, :], scores, big_neg)
+        probs = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, L, self.model_dim)
+        return dense("wo")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    model_dim: int
+    mlp_dim: int
+    variant: str
+    dropout: float
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, pad_mask, rel_bias, deterministic: bool = True):
+        norm = (lambda n: RmsNorm(dtype=self.dtype, name=n)) if self.variant == "t5" \
+            else (lambda n: nn.LayerNorm(dtype=self.dtype, name=n))
+        use_bias = self.variant != "t5"
+
+        h = norm("ln_attn")(x)
+        h = Attention(self.num_heads, self.model_dim, use_bias,
+                      dtype=self.dtype, name="attn")(h, pad_mask, rel_bias)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        x = x + h
+
+        h = norm("ln_mlp")(x)
+        if self.variant == "t5":  # gated GELU, no biases (mT5 geometry)
+            wi0 = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
+                           name="wi_0")(h)
+            wi1 = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
+                           name="wi_1")(h)
+            h = nn.gelu(wi0) * wi1
+            h = nn.Dense(self.model_dim, use_bias=False, dtype=self.dtype,
+                         name="wo_mlp")(h)
+        else:
+            h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="wi")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.model_dim, dtype=self.dtype, name="wo_mlp")(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class TransformerEncoder(nn.Module):
+    vocab_size: int
+    num_layers: int = 4
+    num_heads: int = 4
+    model_dim: int = 256
+    mlp_dim: int = 1024
+    out_dim: int = 256
+    max_len: int = 128
+    dropout: float = 0.1
+    variant: str = "bert"          # bert | t5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        # ids: [B, L] subword ids, 0 = pad.
+        B, L = ids.shape
+        pad_mask = ids > 0
+        x = nn.Embed(self.vocab_size, self.model_dim, dtype=self.dtype,
+                     name="tok_embed")(ids)
+        rel_bias = None
+        if self.variant == "bert":
+            pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                             (self.max_len, self.model_dim))
+            x = x + pos[:L].astype(self.dtype)[None]
+        else:
+            # shared-across-layers relative position bias (T5 style)
+            pos = jnp.arange(L)
+            buckets = _relative_position_bucket(pos[None, :] - pos[:, None])
+            table = self.param("rel_bias", nn.initializers.normal(0.02),
+                               (32, self.num_heads))
+            rel_bias = table[buckets].transpose(2, 0, 1)[None]     # [1, H, L, L]
+            rel_bias = rel_bias.astype(jnp.float32)
+        x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.model_dim, self.mlp_dim,
+                      self.variant, self.dropout, dtype=self.dtype,
+                      name=f"block{i}")(x, pad_mask, rel_bias, deterministic)
+        x = (RmsNorm(dtype=self.dtype, name="ln_final") if self.variant == "t5"
+             else nn.LayerNorm(dtype=self.dtype, name="ln_final"))(x)
+        # masked mean pool
+        m = pad_mask[..., None].astype(jnp.float32)
+        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        out = nn.Dense(self.out_dim, dtype=jnp.float32, name="proj")(pooled)
+        return out                                                  # [B, D] f32

@@ -1,0 +1,56 @@
+"""Two-tower wrapper: query tower + page tower + learnable logit scale
+(SURVEY.md §3 #9; BASELINE.json:5,9).
+
+Towers are any encoder from the zoo. `shared=True` ties the weights (one
+tower applied to both sides); otherwise towers are independent, matching the
+reference's separate query/page encoders. The logit scale is a learnable
+log-inverse-temperature for the cosine-contrastive loss, clamped at apply
+time for stability.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class TwoTower(nn.Module):
+    query_tower: nn.Module
+    page_tower: nn.Module         # ignored (aliased) when shared=True
+    shared: bool = False
+    temperature_init: float = 20.0
+
+    def setup(self) -> None:
+        self.log_scale = self.param(
+            "log_scale",
+            lambda rng: jnp.asarray(np.log(self.temperature_init), jnp.float32))
+
+    def _page_enc(self) -> nn.Module:
+        return self.query_tower if self.shared else self.page_tower
+
+    def encode_query(self, ids: jnp.ndarray,
+                     deterministic: bool = True) -> jnp.ndarray:
+        return self.query_tower(ids, deterministic=deterministic)
+
+    def encode_page(self, ids: jnp.ndarray,
+                    deterministic: bool = True) -> jnp.ndarray:
+        return self._page_enc()(ids, deterministic=deterministic)
+
+    def scale(self) -> jnp.ndarray:
+        return jnp.minimum(jnp.exp(self.log_scale), 100.0)
+
+    def __call__(self, query_ids: jnp.ndarray, page_ids: jnp.ndarray,
+                 neg_page_ids: jnp.ndarray | None = None,
+                 deterministic: bool = True):
+        """Returns (q_vec [B,D], p_vec [B,D], neg_vec [B,H,D] | None, scale)."""
+        q = self.encode_query(query_ids, deterministic)
+        p = self.encode_page(page_ids, deterministic)
+        neg = None
+        if neg_page_ids is not None:
+            B, H = neg_page_ids.shape[:2]
+            flat = neg_page_ids.reshape((B * H,) + neg_page_ids.shape[2:])
+            neg = self._page_enc()(flat, deterministic=deterministic)
+            neg = neg.reshape(B, H, -1)
+        return q, p, neg, self.scale()

@@ -1,0 +1,5 @@
+"""Hot ops: chunked on-device top-k scoring shared by eval + ANN mining
+(SURVEY.md §3 #21-22)."""
+from dnn_page_vectors_tpu.ops.topk import chunked_topk
+
+__all__ = ["chunked_topk"]

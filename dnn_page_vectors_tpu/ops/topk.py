@@ -1,0 +1,63 @@
+"""Chunked brute-force top-k over page vectors (SURVEY.md §3 #21-22).
+
+This is the TPU-native ANN substrate: instead of a CPU FAISS index, score
+queries against the corpus with MXU matmuls and keep a running top-k via
+`lax.scan` + `lax.top_k` — HBM never holds more than one [Bq, chunk] score
+block, so the corpus side streams at HBM bandwidth while compute stays on
+the MXU. Exact (brute-force) search; at 1B pages it shards over the mesh
+'data' axis with a final cross-shard merge (see mine/ann.py, evals/recall.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("k", "chunk"))
+def chunked_topk(q: jnp.ndarray, pages: jnp.ndarray, k: int = 10,
+                 chunk: int = 8192) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Running top-k of q @ pages.T.
+
+    q: [Bq, D] (pre-normalized for cosine); pages: [N, D]; returns
+    (scores [Bq, k], indices [Bq, k]) with indices into `pages` rows.
+    N is padded up to a chunk multiple internally; pad rows score -inf.
+    """
+    Bq, D = q.shape
+    N = pages.shape[0]
+    chunk = min(chunk, max(N, 1))
+    pad = (-N) % chunk
+    if pad:
+        pages = jnp.concatenate(
+            [pages, jnp.zeros((pad, D), pages.dtype)], axis=0)
+    n_chunks = pages.shape[0] // chunk
+    pages = pages.reshape(n_chunks, chunk, D)
+    valid = N  # rows >= valid are padding
+
+    init_scores = jnp.full((Bq, k), -jnp.inf, jnp.float32)
+    init_idx = jnp.full((Bq, k), -1, jnp.int32)
+
+    def body(carry, inp):
+        best_s, best_i = carry
+        ci, block = inp                                  # block: [chunk, D]
+        # HIGHEST precision: ranking fidelity matters more than the ~2x MXU
+        # cost of the fp32-via-bf16-passes matmul on TPU.
+        s = jnp.matmul(q, block.T, precision=lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)  # [Bq, chunk]
+        base = ci * chunk
+        ids = base + jnp.arange(chunk, dtype=jnp.int32)
+        s = jnp.where(ids[None, :] < valid, s, -jnp.inf)
+        cat_s = jnp.concatenate([best_s, s], axis=1)
+        cat_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(ids[None], (Bq, chunk))], axis=1)
+        top_s, pos = lax.top_k(cat_s, k)
+        top_i = jnp.take_along_axis(cat_i, pos, axis=1)
+        return (top_s, top_i), None
+
+    (scores, idx), _ = lax.scan(
+        body, (init_scores, init_idx),
+        (jnp.arange(n_chunks, dtype=jnp.int32), pages))
+    return scores, idx

@@ -1,0 +1,16 @@
+"""Parallelism/runtime layer (SURVEY.md §2 layer 3, §3 #13-18).
+
+The reference scaled with torch-DDP gradient all-reduce over NCCL
+(BASELINE.json:5). The TPU-native equivalent implemented here is GSPMD:
+construct a `jax.sharding.Mesh` with ('data', 'model') axes, annotate the
+batch over 'data' (DP) and the transformer matmuls over 'model' (TP), and
+let XLA insert psum / all-gather / reduce-scatter over ICI inside the one
+compiled program. There is no user-level collective call on the train path.
+"""
+from dnn_page_vectors_tpu.parallel.mesh import (
+    make_mesh, fit_mesh_to_devices, multihost_init)
+from dnn_page_vectors_tpu.parallel.sharding import (
+    batch_sharding, replicated, param_shardings, shard_params)
+
+__all__ = ["make_mesh", "fit_mesh_to_devices", "multihost_init",
+           "batch_sharding", "replicated", "param_shardings", "shard_params"]

@@ -1,0 +1,64 @@
+"""Device-mesh construction + multi-host control plane (SURVEY.md §3 #18).
+
+Mesh axes:
+  * 'data'  — data parallelism; batch dim sharded here; gradient psum rides
+              ICI (within a slice) / DCN (across slices), replacing the
+              reference's NCCL all-reduce (BASELINE.json:5).
+  * 'model' — tensor parallelism for the big transformer matmuls (mT5-base
+              config; SURVEY.md §3 #14).
+
+`jax.distributed.initialize` is the only cross-process step in the whole
+framework (SURVEY.md §4.5); every later collective lives inside compiled
+XLA programs.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from dnn_page_vectors_tpu.config import MeshConfig
+
+
+def multihost_init(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """One process per TPU host. No-op when single-process (the common dev
+    case and the sandbox case). On a real pod slice the TPU runtime provides
+    coordinator/topology via env and bare initialize() suffices."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+
+
+def make_mesh(cfg: MeshConfig) -> Mesh:
+    devices = jax.devices()
+    need = cfg.num_devices
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {cfg.data}x{cfg.model} needs {need} devices, "
+            f"have {len(devices)}; use fit_mesh_to_devices() for dev runs")
+    arr = np.asarray(devices[:need]).reshape(cfg.data, cfg.model)
+    return Mesh(arr, ("data", "model"))
+
+
+def fit_mesh_to_devices(cfg: MeshConfig,
+                        devices: Optional[list] = None) -> MeshConfig:
+    """Shrink a config's mesh to the devices actually present, preserving the
+    model axis when possible. Lets the v5p-64 configs run in the 1-chip
+    sandbox / 8-fake-device CPU tests unchanged."""
+    n = len(devices if devices is not None else jax.devices())
+    model = min(cfg.model, n)
+    while n % model:
+        model -= 1
+    data = min(cfg.data, n // model)
+    # round data down to a divisor of the remaining devices
+    while (n // model) % data:
+        data -= 1
+    return MeshConfig(data=data, model=model)

@@ -1,0 +1,69 @@
+"""Sharding rules: param-path regex -> PartitionSpec (SURVEY.md §3 #13-14).
+
+DP: every batch array is sharded on its leading dim over 'data'.
+TP: transformer matmuls are sharded over 'model' by the rules below, keyed
+on the param names in models/transformer.py. Everything unmatched is
+replicated. XLA propagates these annotations through the whole program and
+inserts the ICI collectives (the reference's NCCL role, BASELINE.json:5).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, List, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path-regex, spec). First match wins. Paths look like
+# "params/page_tower/block0/attn/wq/kernel".
+TP_RULES: List[Tuple[str, P]] = [
+    # attention: qkv project model_dim -> heads (shard output/head dim)
+    (r".*/attn/w[qkv]/kernel$", P(None, "model")),
+    (r".*/attn/w[qkv]/bias$", P("model")),
+    # attention output: heads -> model_dim (shard input/head dim)
+    (r".*/attn/wo/kernel$", P("model", None)),
+    # MLP in: model_dim -> mlp_dim (shard mlp dim)
+    (r".*/(wi|wi_0|wi_1)/kernel$", P(None, "model")),
+    (r".*/(wi|wi_0|wi_1)/bias$", P("model")),
+    # MLP out: mlp_dim -> model_dim
+    (r".*/wo_mlp/kernel$", P("model", None)),
+    # token embedding: shard the embed dim (gather output stays sharded on
+    # the feature axis, feeding the TP matmuls without a reshard)
+    (r".*/tok_embed/embedding$", P(None, "model")),
+]
+
+
+def _path_str(path: Tuple[Any, ...]) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/".join(parts)
+
+
+def spec_for_param(path_str: str) -> P:
+    for pattern, spec in TP_RULES:
+        if re.match(pattern, path_str):
+            return spec
+    return P()
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedSharding matching `params`. With mesh model=1 every
+    rule degenerates to replication, so the same code path serves pure-DP."""
+    def _one(path, _leaf):
+        return NamedSharding(mesh, spec_for_param(_path_str(path)))
+    return jax.tree_util.tree_map_with_path(_one, params)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis 'data' sharding for every batch array (rank-agnostic:
+    P('data') leaves trailing dims replicated)."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,5 @@
+"""Trainer layer: optimizer, jitted train step, loop, checkpointing
+(SURVEY.md §2 layer 4)."""
+from dnn_page_vectors_tpu.train.loop import Trainer, TrainState
+
+__all__ = ["Trainer", "TrainState"]

@@ -1,0 +1,188 @@
+"""Contrastive training loop (SURVEY.md §3 #12; call stack §4.1).
+
+The hot loop is ONE jit-compiled `train_step` with donated state:
+  encode both towers -> global-batch cosine-contrastive loss -> grad ->
+  optax update. Under a >1-device mesh the same step is compiled with the
+  batch sharded over 'data' and params sharded by parallel/sharding.py; XLA
+  emits the gradient psum / page-vector all-gather over ICI (the reference's
+  torch-DDP/NCCL role, BASELINE.json:5). Everything host-side (tokenization,
+  logging, checkpointing) stays off the compiled path.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dnn_page_vectors_tpu.config import Config
+from dnn_page_vectors_tpu.data.loader import (
+    TrainBatcher, build_corpus, build_tokenizer, prefetch_to_device)
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.models.factory import build_two_tower
+from dnn_page_vectors_tpu.models.losses import cosine_contrastive_loss
+from dnn_page_vectors_tpu.parallel.mesh import fit_mesh_to_devices, make_mesh
+from dnn_page_vectors_tpu.parallel.sharding import (
+    batch_sharding, param_shardings, replicated, shard_params)
+from dnn_page_vectors_tpu.train.optimizer import make_optimizer
+from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray          # int32 scalar
+
+
+def make_train_step(model, tx):
+    """Build the (un-jitted) global-batch train step; caller jits with
+    shardings + donation."""
+
+    def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
+                   base_rng: jax.Array) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        rng = jax.random.fold_in(base_rng, state.step)
+
+        def loss_fn(params):
+            q, p, neg, scale = model.apply(
+                params, batch["query"], batch["page"],
+                batch.get("neg_page"), deterministic=False,
+                rngs={"dropout": rng})
+            return cosine_contrastive_loss(q, p, scale, neg)
+
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(params=params, opt_state=opt_state,
+                          step=state.step + 1), metrics
+
+    return train_step
+
+
+class Trainer:
+    """Wires config -> data -> model -> mesh -> compiled step (§4.1)."""
+
+    def __init__(self, cfg: Config, corpus: Optional[ToyCorpus] = None,
+                 hard_negative_lookup=None, workdir: Optional[str] = None):
+        self.cfg = cfg
+        self.workdir = workdir or cfg.workdir
+        os.makedirs(self.workdir, exist_ok=True)
+        self.corpus = corpus if corpus is not None else build_corpus(cfg)
+        self.query_tok, self.page_tok = build_tokenizer(
+            cfg, self.corpus, cache_dir=self.workdir)
+        self.model = build_two_tower(cfg, self.page_tok.vocab_size)
+        fitted = fit_mesh_to_devices(cfg.mesh)
+        if (fitted.data, fitted.model) != (cfg.mesh.data, cfg.mesh.model):
+            if cfg.mesh.strict:
+                raise RuntimeError(
+                    f"mesh.strict: config wants {cfg.mesh.data}x"
+                    f"{cfg.mesh.model} devices but only "
+                    f"{len(jax.devices())} are visible")
+            print(f"WARNING: mesh {cfg.mesh.data}x{cfg.mesh.model} shrunk "
+                  f"to {fitted.data}x{fitted.model} for "
+                  f"{len(jax.devices())} visible device(s); set "
+                  "mesh.strict=true to fail instead", file=sys.stderr)
+        self.mesh = make_mesh(fitted)
+        self.tx = make_optimizer(cfg.train)
+        self.hard_negative_lookup = hard_negative_lookup
+        self._compiled = None
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, seed: Optional[int] = None) -> TrainState:
+        seed = self.cfg.train.seed if seed is None else seed
+        rng = jax.random.PRNGKey(seed)
+        d = self.cfg.data
+        dummy_q = jnp.zeros((2, d.query_len) + self._tok_extra(), jnp.int32)
+        dummy_p = jnp.zeros((2, d.page_len) + self._tok_extra(), jnp.int32)
+        params = self.model.init(rng, dummy_q, dummy_p)
+        params = shard_params(params, self.mesh)
+        # Moments (zeros_like) inherit param shardings, but optax also makes
+        # fresh scalars (adam's count) that land committed on device 0; every
+        # leaf must live on THIS mesh or jit rejects the mixed device sets.
+        mesh_devs = frozenset(self.mesh.devices.flat)
+        def _on_mesh(leaf):
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None and frozenset(sh.device_set) == mesh_devs:
+                return leaf
+            return jax.device_put(leaf, replicated(self.mesh))
+        opt_state = jax.tree_util.tree_map(_on_mesh, self.tx.init(params))
+        step = jax.device_put(jnp.zeros((), jnp.int32), replicated(self.mesh))
+        return TrainState(params=params, opt_state=opt_state, step=step)
+
+    def _tok_extra(self) -> tuple:
+        return ((self.cfg.data.trigrams_per_word,)
+                if self.cfg.data.tokenizer == "trigram" else ())
+
+    # -- compiled step ----------------------------------------------------
+    def compiled_step(self, state: TrainState):
+        if self._compiled is None:
+            step_fn = make_train_step(self.model, self.tx)
+            state_sh = jax.tree_util.tree_map(lambda x: x.sharding, state)
+            self._compiled = jax.jit(
+                step_fn,
+                in_shardings=(state_sh, batch_sharding(self.mesh),
+                              replicated(self.mesh)),
+                out_shardings=(state_sh, replicated(self.mesh)),
+                donate_argnums=(0,),
+            )
+        return self._compiled
+
+    def batches(self, start_step: int = 0) -> Iterator[Any]:
+        batcher = TrainBatcher(
+            self.corpus, self.query_tok, self.page_tok,
+            batch_size=self.cfg.train.batch_size, seed=self.cfg.train.seed,
+            start_step=start_step,
+            hard_negative_lookup=self.hard_negative_lookup)
+        return prefetch_to_device(iter(batcher),
+                                  sharding=batch_sharding(self.mesh))
+
+    # -- driver -----------------------------------------------------------
+    def train(self, steps: Optional[int] = None,
+              state: Optional[TrainState] = None,
+              log: Optional[MetricsLogger] = None,
+              ckpt_manager=None) -> Tuple[TrainState, Dict[str, float]]:
+        """Runs `steps` more steps. The data stream resumes at state.step, so
+        a restored run sees the same batch order as an uninterrupted one.
+        With ckpt_manager, saves (async) every cfg.train.checkpoint_every
+        steps — the crash-recovery half of SURVEY.md §5.3."""
+        cfg = self.cfg
+        steps = cfg.train.steps if steps is None else steps
+        state = self.init_state() if state is None else state
+        step_fn = self.compiled_step(state)
+        base_rng = jax.device_put(jax.random.PRNGKey(cfg.train.seed + 1),
+                                  replicated(self.mesh))
+        log = log or MetricsLogger(self.workdir)
+        pages_per_step = cfg.train.batch_size
+        n_dev = self.mesh.devices.size
+        start_step = int(state.step)
+        it = self.batches(start_step=start_step)
+        last: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        for i in range(steps):
+            batch = next(it)
+            state, metrics = step_fn(state, batch, base_rng)
+            if (i + 1) % cfg.train.log_every == 0 or i + 1 == steps:
+                metrics = {k: float(v) for k, v in metrics.items()}
+                jax.block_until_ready(state.params)
+                dt = time.perf_counter() - t0
+                done = int(state.step) - start_step
+                metrics["pages_per_sec_per_chip"] = (
+                    done * pages_per_step / dt / n_dev)
+                metrics["step"] = int(state.step)
+                log.write(metrics)
+                last = metrics
+            if (ckpt_manager is not None
+                    and (i + 1) % cfg.train.checkpoint_every == 0
+                    and i + 1 < steps):  # final save is the caller's
+                ckpt_manager.save(int(state.step), state)
+        return state, last

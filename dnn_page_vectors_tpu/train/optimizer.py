@@ -1,0 +1,23 @@
+"""Optimizer + LR schedule (SURVEY.md §3 #11): adamw, warmup-cosine."""
+from __future__ import annotations
+
+import optax
+
+from dnn_page_vectors_tpu.config import TrainConfig
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=cfg.learning_rate,
+        warmup_steps=max(cfg.warmup_steps, 1),
+        decay_steps=max(cfg.steps, cfg.warmup_steps + 1),
+        end_value=cfg.learning_rate * 0.1,
+    )
+    if cfg.optimizer == "sgd":
+        opt = optax.sgd(schedule)
+    elif cfg.optimizer == "adamw":
+        opt = optax.adamw(schedule, weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    return optax.chain(optax.clip_by_global_norm(1.0), opt)

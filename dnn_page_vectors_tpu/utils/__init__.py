@@ -1,0 +1,4 @@
+"""Utilities: metrics logging, profiling hooks (SURVEY.md §3 #26, §5.1, §5.5)."""
+from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+
+__all__ = ["MetricsLogger"]

@@ -1,0 +1,37 @@
+"""Metrics/observability (SURVEY.md §3 #26, §5.5).
+
+Emits the two baseline metrics verbatim — pages/sec/chip and Recall@10
+(BASELINE.json:2) — as jsonl under workdir, mirrored to stdout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    def __init__(self, workdir: Optional[str] = None, name: str = "metrics",
+                 echo: bool = True):
+        self.echo = echo
+        self._f = None
+        if workdir:
+            os.makedirs(workdir, exist_ok=True)
+            self._f = open(os.path.join(workdir, f"{name}.jsonl"), "a")
+
+    def write(self, metrics: Dict[str, Any]) -> None:
+        rec = {"ts": time.time(), **{
+            k: (float(v) if hasattr(v, "item") else v)
+            for k, v in metrics.items()}}
+        line = json.dumps(rec, sort_keys=True)
+        if self._f:
+            self._f.write(line + "\n")
+            self._f.flush()
+        if self.echo:
+            print(line, file=sys.stderr)
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()

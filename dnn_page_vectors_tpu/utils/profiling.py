@@ -1,0 +1,18 @@
+"""Tracing/profiling hooks (SURVEY.md §5.1): jax.profiler traces around the
+train/embed hot loops, TensorBoard-readable, behind a --profile CLI flag."""
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def maybe_profile(enabled: bool, workdir: str):
+    if not enabled:
+        yield
+        return
+    import jax
+    trace_dir = os.path.join(workdir, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield

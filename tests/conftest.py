@@ -1,0 +1,32 @@
+"""Test harness: CPU backend with 8 fake devices (SURVEY.md §5).
+
+Env must be set before jax initialises — this file is imported by pytest
+before any test module touches jax. The 8-device CPU mesh is the standard
+JAX idiom for testing multi-chip sharding without a pod; the driver's
+separate `dryrun_multichip` uses the same mechanism.
+"""
+import os
+
+# Force CPU: the sandbox exports JAX_PLATFORMS=axon (one real TPU chip) and a
+# sitecustomize that imports jax at interpreter start, so plain env edits are
+# too late — use config.update before any backend initialises. The test suite
+# always wants the 8-fake-device CPU mesh.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs

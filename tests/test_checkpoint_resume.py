@@ -1,0 +1,157 @@
+"""Checkpoint/resume + failure-recovery tests (SURVEY.md §5.3-5.4):
+mid-run checkpointing, restore equivalence (params AND data order), and the
+fault-injection bulk-embed resume test.
+"""
+import dataclasses
+
+import jax
+import numpy as np
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.data.loader import TrainBatcher
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+from dnn_page_vectors_tpu.train.loop import Trainer
+
+
+def _cfg():
+    return get_config("cdssm_toy", {
+        "data.num_pages": 256,
+        "data.trigram_buckets": 1024,
+        "model.embed_dim": 32,
+        "model.conv_channels": 32,
+        "model.out_dim": 32,
+        "model.dtype": "float32",
+        "train.batch_size": 64,
+        "train.steps": 6,
+        "train.warmup_steps": 2,
+        "train.log_every": 100,
+        "train.checkpoint_every": 2,
+    })
+
+
+def _params_flat(state):
+    return jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, state.params))
+
+
+def test_resume_equals_uninterrupted(tmp_path):
+    """train 6 == train 3 + restore + train 3, params AND data order."""
+    cfg = _cfg()
+    t1 = Trainer(cfg, workdir=str(tmp_path / "a"))
+    full, _ = t1.train(steps=6)
+
+    t2 = Trainer(cfg, workdir=str(tmp_path / "b"))
+    mgr = CheckpointManager(str(tmp_path / "b" / "ckpt"))
+    half, _ = t2.train(steps=3)
+    mgr.save(3, half, wait=True)
+
+    t3 = Trainer(cfg, workdir=str(tmp_path / "b"))
+    restored = mgr.restore(t3.init_state())
+    assert int(restored.step) == 3
+    resumed, _ = t3.train(steps=3, state=restored)
+    mgr.close()
+
+    for a, b in zip(_params_flat(full), _params_flat(resumed)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_midrun_checkpointing(tmp_path):
+    cfg = _cfg()
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    trainer.train(steps=5, ckpt_manager=mgr)  # checkpoint_every=2
+    mgr._mgr.wait_until_finished()
+    # saves at steps 2 and 4 (step 5 is the caller's final save)
+    assert mgr.latest_step() == 4
+    mgr.close()
+
+
+def test_batcher_resume_matches_data_order():
+    cfg = _cfg()
+    t = Trainer(cfg, workdir=None)
+    b_full = TrainBatcher(t.corpus, t.query_tok, t.page_tok, 64, seed=5)
+    it = iter(b_full)
+    want = [next(it)["page_id"] for _ in range(7)]  # crosses epoch boundary
+    b_resumed = TrainBatcher(t.corpus, t.query_tok, t.page_tok, 64, seed=5,
+                             start_step=5)
+    it2 = iter(b_resumed)
+    got = [next(it2)["page_id"] for _ in range(2)]
+    np.testing.assert_array_equal(want[5], got[0])
+    np.testing.assert_array_equal(want[6], got[1])
+
+
+def test_batcher_rejects_oversized_batch():
+    cfg = _cfg()
+    t = Trainer(cfg, workdir=None)
+    try:
+        TrainBatcher(t.corpus, t.query_tok, t.page_tok, batch_size=10_000)
+    except ValueError as e:
+        assert "batch_size" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_bulk_embed_fault_injection_resume(tmp_path):
+    """Kill the job mid-embed (simulated), restart, assert the final store
+    equals an uninterrupted run's (SURVEY.md §5.3)."""
+    cfg = _cfg()
+    trainer = Trainer(cfg, workdir=str(tmp_path / "t"))
+    state = trainer.init_state()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, trainer.query_tok)
+
+    clean = VectorStore(str(tmp_path / "clean"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, clean, batch_size=32)
+
+    crashy = VectorStore(str(tmp_path / "crashy"), dim=32, shard_size=64)
+
+    class Boom(RuntimeError):
+        pass
+
+    real_write = crashy.write_shard
+    calls = {"n": 0}
+
+    def failing_write(index, ids, vecs):
+        if calls["n"] == 2:
+            raise Boom("simulated crash mid-job")
+        calls["n"] += 1
+        real_write(index, ids, vecs)
+
+    crashy.write_shard = failing_write
+    try:
+        emb.embed_corpus(trainer.corpus, crashy, batch_size=32)
+        raise AssertionError("expected simulated crash")
+    except Boom:
+        pass
+
+    # restart: fresh store object on the same dir resumes from the manifest
+    resumed = VectorStore(str(tmp_path / "crashy"))
+    assert len(resumed.completed_shards()) == 2
+    emb.embed_corpus(trainer.corpus, resumed, batch_size=32)
+
+    ids_a, vecs_a = clean.load_all()
+    ids_b, vecs_b = resumed.load_all()
+    oa, ob = np.argsort(ids_a), np.argsort(ids_b)
+    np.testing.assert_array_equal(ids_a[oa], ids_b[ob])
+    np.testing.assert_allclose(vecs_a[oa].astype(np.float32),
+                               vecs_b[ob].astype(np.float32), atol=1e-3)
+
+
+def test_jsonl_corpus_roundtrip(tmp_path):
+    import json
+    path = tmp_path / "corpus.jsonl"
+    with open(path, "w") as f:
+        for i in range(8):
+            f.write(json.dumps({"query": f"find page {i}",
+                                "page": f"this is page {i} about topic {i % 3}"})
+                    + "\n")
+    cfg = get_config("cdssm_toy", {"data.corpus": f"jsonl:{path}",
+                                   "data.num_pages": 8})
+    from dnn_page_vectors_tpu.data.loader import build_corpus
+    corpus = build_corpus(cfg)
+    assert corpus.num_pages == 8
+    assert corpus.page_text(3) == "this is page 3 about topic 0"
+    assert corpus.query_text(3) == "find page 3"
+    assert len(list(corpus.all_texts())) == 16

@@ -1,0 +1,86 @@
+"""Unit tests: toy corpus + tokenizers (SURVEY.md §3 #1-3, #27)."""
+import numpy as np
+
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.data.trigram import TrigramTokenizer, fnv1a, word_trigrams
+from dnn_page_vectors_tpu.data.words import WordTokenizer
+from dnn_page_vectors_tpu.data.subword import SubwordTokenizer
+
+
+def test_toy_corpus_deterministic():
+    c1 = ToyCorpus(num_pages=100, seed=7)
+    c2 = ToyCorpus(num_pages=100, seed=7)
+    for i in (0, 13, 99):
+        assert c1.page_text(i) == c2.page_text(i)
+        assert c1.query_text(i) == c2.query_text(i)
+    assert c1.page_text(3) != ToyCorpus(num_pages=100, seed=8).page_text(3)
+
+
+def test_toy_query_page_overlap():
+    c = ToyCorpus(num_pages=50, seed=0)
+    for i in (0, 17, 42):
+        page_words = set(c.page_text(i).split())
+        query_words = set(c.query_text(i).split())
+        # key words guarantee lexical overlap with the gold page
+        assert len(page_words & query_words) >= 2
+        # and little overlap with an unrelated page of another topic
+        other = set(c.page_text((i + 3) % 50).split())
+        assert len(query_words & other) < len(query_words & page_words)
+
+
+def test_trigram_hash_stable():
+    # FNV-1a must be process-stable (vector-store reproducibility)
+    assert fnv1a(b"abc") == 0xE71FA2190541574B
+    assert word_trigrams("cat") == ["#ca", "cat", "at#"]
+    assert word_trigrams("a") == ["#a#"]
+
+
+def test_trigram_tokenizer_shapes():
+    tok = TrigramTokenizer(buckets=1024, max_words=8, k=4)
+    out = tok.encode("hello world")
+    assert out.shape == (8, 4) and out.dtype == np.int32
+    assert out[0, 0] > 0 and out[2].sum() == 0  # 2 words, rest pad
+    assert (out >= 0).all() and (out <= 1024).all()
+    batch = tok.encode_batch(["a b", "c"])
+    assert batch.shape == (2, 8, 4)
+    # same word -> same ids regardless of position
+    assert (tok.encode("hello x")[0] == tok.encode("y hello")[1]).all()
+
+
+def test_word_tokenizer():
+    texts = ["the cat sat", "the cat ran", "a dog ran"]
+    tok = WordTokenizer.train(texts, vocab_size=10, max_words=4)
+    a = tok.encode("the cat flew")
+    assert a.shape == (4,)
+    assert a[0] > 1 and a[1] > 1   # known words
+    assert a[2] == 1               # unk
+    assert a[3] == 0               # pad
+    # determinism across retrains
+    tok2 = WordTokenizer.train(texts, vocab_size=10, max_words=4)
+    assert tok.vocab == tok2.vocab
+
+
+def test_subword_tokenizer_styles(tmp_path):
+    texts = ["banana bandana cabana"] * 20 + ["cab band ban"] * 10
+    for style in ("wordpiece", "sentencepiece"):
+        tok = SubwordTokenizer.train(texts, vocab_size=64, style=style,
+                                     max_tokens=16)
+        out = tok.encode("banana cab")
+        assert out.shape == (16,)
+        assert out[0] > 1  # known material, no unk at head
+        toks = tok.tokens("banana")
+        assert toks, toks
+        if style == "sentencepiece":
+            assert toks[0].startswith("▁")
+        # round-trip through save/load
+        p = str(tmp_path / f"{style}.json")
+        tok.save(p)
+        tok2 = SubwordTokenizer.load(p)
+        assert (tok2.encode("banana cab") == out).all()
+
+
+def test_subword_deterministic():
+    texts = ["pagino pagina margine"] * 15
+    v1 = SubwordTokenizer.train(texts, vocab_size=48).vocab
+    v2 = SubwordTokenizer.train(texts, vocab_size=48).vocab
+    assert v1 == v2
