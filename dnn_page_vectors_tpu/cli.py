@@ -16,6 +16,9 @@ import os
 from typing import Dict
 
 from dnn_page_vectors_tpu.config import CONFIGS, get_config
+from dnn_page_vectors_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
 
 
 def _parse_overrides(pairs) -> Dict[str, object]:

@@ -45,6 +45,7 @@ class ModelConfig:
     mlp_dim: int = 1024
     model_dim: int = 256
     dropout: float = 0.1
+    attention: str = "dense"         # dense | flash (Pallas kernel; long pages)
     shared_towers: bool = False      # share params between query/page towers
     dtype: str = "bfloat16"          # compute dtype on MXU
 
@@ -59,6 +60,7 @@ class MeshConfig:
     """
     data: int = 1
     model: int = 1
+    seq: int = 1                     # sequence/context parallelism (ring attn)
     # strict=True: fail hard when fewer devices are visible than configured
     # (production pods); strict=False: shrink to fit with a loud warning
     # (dev boxes, tests, the 1-chip sandbox).
@@ -66,7 +68,7 @@ class MeshConfig:
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model
+        return self.data * self.model * self.seq
 
 
 @dataclasses.dataclass(frozen=True)
@@ -218,12 +220,33 @@ def mt5_multilingual() -> Config:
     )
 
 
+def bert_long_sp() -> Config:
+    """Long-page variant beyond the five canonical configs: 1024-token pages
+    with ring-attention sequence parallelism over the mesh 'seq' axis
+    (parallel/ring_attention.py) and Pallas flash attention available via
+    model.attention=flash for the single-chip case. Covers the long-context
+    scaling requirement the short-sequence canonical configs don't exercise."""
+    return Config(
+        name="bert_long_sp",
+        data=DataConfig(tokenizer="wordpiece", corpus="toy",
+                        num_pages=1_000_000, vocab_size=30_522,
+                        page_len=1024, query_len=32),
+        model=ModelConfig(encoder="bert", num_layers=4, num_heads=8,
+                          model_dim=512, mlp_dim=2048, out_dim=256,
+                          attention="ring"),
+        mesh=MeshConfig(data=16, seq=4),
+        train=TrainConfig(batch_size=2_048, steps=100_000,
+                          learning_rate=5e-4),
+    )
+
+
 CONFIGS = {
     "cdssm_toy": cdssm_toy,
     "kim_cnn_v5e8": kim_cnn_v5e8,
     "bert_mini_v5p16": bert_mini_v5p16,
     "hardneg_v5p64": hardneg_v5p64,
     "mt5_multilingual": mt5_multilingual,
+    "bert_long_sp": bert_long_sp,
 }
 
 

@@ -39,24 +39,24 @@ def word_trigrams(word: str) -> List[str]:
 class TrigramTokenizer:
     """text -> int32 ids of shape [max_words, k] (0 = pad)."""
 
-    def __init__(self, buckets: int = 16_384, max_words: int = 64, k: int = 8):
+    def __init__(self, buckets: int = 16_384, max_words: int = 64, k: int = 8,
+                 use_native: bool = True):
         self.buckets = buckets
         self.max_words = max_words
         self.k = k
         self._native = None
-        try:  # optional C++ fast path; pure-Python fallback below
-            from dnn_page_vectors_tpu.native import trigram_native
-            self._native = trigram_native
-        except Exception:
-            self._native = None
+        if use_native:
+            try:  # C++ fast path (builds on first import); Python fallback
+                from dnn_page_vectors_tpu.native import trigram_native
+                self._native = trigram_native
+            except Exception:
+                self._native = None
 
     @property
     def vocab_size(self) -> int:
         return self.buckets + 1  # + padding id 0
 
-    def encode(self, text: str) -> np.ndarray:
-        if self._native is not None:
-            return self._native.encode(text, self.buckets, self.max_words, self.k)
+    def _encode_py(self, text: str) -> np.ndarray:
         out = np.zeros((self.max_words, self.k), dtype=np.int32)
         for wi, word in enumerate(text.split()[: self.max_words]):
             tgs = word_trigrams(word)[: self.k]
@@ -64,5 +64,15 @@ class TrigramTokenizer:
                 out[wi, ti] = 1 + fnv1a(tg.encode("utf-8")) % self.buckets
         return out
 
+    def encode(self, text: str) -> np.ndarray:
+        if self._native is not None:
+            return self._native.encode(text, self.buckets, self.max_words,
+                                       self.k)
+        return self._encode_py(text)
+
     def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
-        return np.stack([self.encode(t) for t in texts])
+        if self._native is not None:
+            return self._native.encode_batch(texts, self.buckets,
+                                             self.max_words, self.k)
+        return np.stack([self.encode(t) for t in texts]) if texts else \
+            np.zeros((0, self.max_words, self.k), np.int32)

@@ -1,6 +1,8 @@
 """Encoder-zoo factory: ModelConfig -> TwoTower module (SURVEY.md §3 #5-9)."""
 from __future__ import annotations
 
+from typing import Any, Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -13,7 +15,8 @@ from dnn_page_vectors_tpu.models.two_tower import TwoTower
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
 
 
-def _build_encoder(cfg: Config, vocab_size: int, name: str) -> nn.Module:
+def _build_encoder(cfg: Config, vocab_size: int, name: str,
+                   mesh: Optional[Any] = None) -> nn.Module:
     m = cfg.model
     dtype = _DTYPES[m.dtype]
     if m.encoder == "cdssm":
@@ -27,6 +30,9 @@ def _build_encoder(cfg: Config, vocab_size: int, name: str) -> nn.Module:
                              conv_channels=m.conv_channels, out_dim=m.out_dim,
                              dropout=m.dropout, dtype=dtype, name=name)
     if m.encoder in ("bert", "t5"):
+        if m.attention not in ("dense", "flash", "ring"):
+            raise ValueError(f"unknown attention kind {m.attention!r} "
+                             "(want dense | flash | ring)")
         max_len = max(cfg.data.query_len, cfg.data.page_len)
         return TransformerEncoder(vocab_size=vocab_size,
                                   num_layers=m.num_layers,
@@ -34,15 +40,19 @@ def _build_encoder(cfg: Config, vocab_size: int, name: str) -> nn.Module:
                                   model_dim=m.model_dim, mlp_dim=m.mlp_dim,
                                   out_dim=m.out_dim, max_len=max_len,
                                   dropout=m.dropout, variant=m.encoder,
+                                  attention_kind=m.attention,
+                                  mesh=mesh if m.attention == "ring" else None,
                                   dtype=dtype, name=name)
     raise ValueError(f"unknown encoder {cfg.model.encoder!r}")
 
 
-def build_two_tower(cfg: Config, vocab_size: int) -> TwoTower:
+def build_two_tower(cfg: Config, vocab_size: int,
+                    mesh: Optional[Any] = None) -> TwoTower:
     """Both towers share one tokenizer vocab (query/page differ only in
-    length), so one vocab_size parameterises both."""
-    query_tower = _build_encoder(cfg, vocab_size, "query_tower")
-    page_tower = _build_encoder(cfg, vocab_size, "page_tower")
+    length), so one vocab_size parameterises both. `mesh` is only needed for
+    model.attention == 'ring' (sequence parallelism)."""
+    query_tower = _build_encoder(cfg, vocab_size, "query_tower", mesh)
+    page_tower = _build_encoder(cfg, vocab_size, "page_tower", mesh)
     return TwoTower(query_tower=query_tower, page_tower=page_tower,
                     shared=cfg.model.shared_towers,
                     temperature_init=cfg.train.temperature_init)

@@ -16,6 +16,8 @@ on the param names used here (wq/wk/wv/wo, wi/wi_0/wi_1/wo_mlp).
 """
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -52,10 +54,16 @@ class RmsNorm(nn.Module):
 
 
 class Attention(nn.Module):
+    """kind: 'dense' (materialised scores), 'flash' (Pallas kernel,
+    ops/flash_attention.py), or 'ring' (sequence-parallel over the mesh
+    'seq' axis, parallel/ring_attention.py — bert variant only; T5 relative
+    bias is not supported across the ring)."""
     num_heads: int
     model_dim: int
     use_bias: bool
     dtype: jnp.dtype = jnp.bfloat16
+    kind: str = "dense"
+    mesh: Any = None          # jax.sharding.Mesh, required for kind='ring'
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, pad_mask: jnp.ndarray,
@@ -68,14 +76,29 @@ class Attention(nn.Module):
         q = dense("wq")(x).reshape(shape)
         k = dense("wk")(x).reshape(shape)
         v = dense("wv")(x).reshape(shape)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        scores = scores.astype(jnp.float32)
-        if rel_bias is not None:
-            scores = scores + rel_bias
-        big_neg = jnp.asarray(-1e9, jnp.float32)
-        scores = jnp.where(pad_mask[:, None, None, :], scores, big_neg)
-        probs = nn.softmax(scores, axis=-1).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, L, self.model_dim)
+        bhld = lambda t: t.transpose(0, 2, 1, 3)
+        if self.kind == "flash":
+            from dnn_page_vectors_tpu.ops.flash_attention import flash_attention
+            bias = None if rel_bias is None else rel_bias[0]  # [H, L, L]
+            out = flash_attention(bhld(q), bhld(k), bhld(v), pad_mask, bias)
+            out = bhld(out.astype(self.dtype))                # [B, L, H, Dh]
+        elif self.kind == "ring":
+            from dnn_page_vectors_tpu.parallel.ring_attention import ring_attention
+            assert rel_bias is None, "ring attention: bert variant only"
+            assert self.mesh is not None, "ring attention needs a mesh"
+            out = ring_attention(self.mesh, bhld(q), bhld(k), bhld(v),
+                                 pad_mask)
+            out = bhld(out.astype(self.dtype))
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            scores = scores.astype(jnp.float32)
+            if rel_bias is not None:
+                scores = scores + rel_bias
+            big_neg = jnp.asarray(-1e9, jnp.float32)
+            scores = jnp.where(pad_mask[:, None, None, :], scores, big_neg)
+            probs = nn.softmax(scores, axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(B, L, self.model_dim)
         return dense("wo")(out)
 
 
@@ -86,6 +109,8 @@ class Block(nn.Module):
     variant: str
     dropout: float
     dtype: jnp.dtype = jnp.bfloat16
+    attention_kind: str = "dense"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, pad_mask, rel_bias, deterministic: bool = True):
@@ -95,7 +120,8 @@ class Block(nn.Module):
 
         h = norm("ln_attn")(x)
         h = Attention(self.num_heads, self.model_dim, use_bias,
-                      dtype=self.dtype, name="attn")(h, pad_mask, rel_bias)
+                      dtype=self.dtype, kind=self.attention_kind,
+                      mesh=self.mesh, name="attn")(h, pad_mask, rel_bias)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         x = x + h
 
@@ -127,6 +153,8 @@ class TransformerEncoder(nn.Module):
     dropout: float = 0.1
     variant: str = "bert"          # bert | t5
     dtype: jnp.dtype = jnp.bfloat16
+    attention_kind: str = "dense"  # dense | flash | ring
+    mesh: Any = None               # required for attention_kind='ring'
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -152,6 +180,7 @@ class TransformerEncoder(nn.Module):
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.model_dim, self.mlp_dim,
                       self.variant, self.dropout, dtype=self.dtype,
+                      attention_kind=self.attention_kind, mesh=self.mesh,
                       name=f"block{i}")(x, pad_mask, rel_bias, deterministic)
         x = (RmsNorm(dtype=self.dtype, name="ln_final") if self.variant == "t5"
              else nn.LayerNorm(dtype=self.dtype, name="ln_final"))(x)

@@ -1,0 +1,48 @@
+"""Native (C++) host-side runtime components, bound via ctypes.
+
+The compute path is JAX/XLA (TPU); this package holds the host-side hot
+loops that feed it — currently the char-trigram tokenizer, whose Python
+inner loop would bottleneck the 1B-page bulk-embed job's host side
+(BASELINE.json:5 keeps tokenization on the TPU VM host).
+
+The shared library is built on first import with g++ (no pybind11 in the
+image; plain C ABI + ctypes). Build failure is non-fatal: importers fall
+back to the pure-Python implementation.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "trigram_hash.cpp")
+_SO = os.path.join(_DIR, "libdpv_native.so")
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"native build failed: {res.stderr[-2000:]}")
+
+
+def _load() -> ctypes.CDLL:
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        _build()
+    lib = ctypes.CDLL(_SO)
+    lib.dpv_encode_trigrams.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+    lib.dpv_encode_trigrams.restype = None
+    lib.dpv_encode_trigrams_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.dpv_encode_trigrams_batch.restype = None
+    return lib
+
+
+_lib = _load()  # raises on failure; data/trigram.py catches and falls back

@@ -1,0 +1,114 @@
+// Native hot path for the char-trigram hashing tokenizer
+// (dnn_page_vectors_tpu/data/trigram.py). The tokenizer runs on the TPU-VM
+// host for every page of a 1B-page corpus (BASELINE.json:5), so the
+// per-character Python loop is the bulk-embed job's host-side bottleneck;
+// this C++ implementation is the equivalent of the reference's native data
+// loader layer, exposed to Python via ctypes (no pybind11 in the image).
+//
+// Semantics mirror trigram.py exactly (tests assert bit-equality):
+//   * words split on ASCII whitespace
+//   * per word: "#" + word + "#", trigrams over UTF-8 *codepoints*
+//   * id = 1 + FNV1a64(utf8 bytes of the trigram) % buckets, 0 = pad
+//   * at most `k` trigrams per word, at most `max_words` words.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 0xCBF29CE484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001B3ULL;
+
+inline uint64_t fnv1a(const char* data, int64_t n) {
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < n; ++i) {
+    h ^= static_cast<uint8_t>(data[i]);
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+inline bool is_space(char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v';
+}
+
+// Number of bytes in the UTF-8 sequence starting at lead byte `c`.
+inline int utf8_len(unsigned char c) {
+  if (c < 0x80) return 1;
+  if ((c >> 5) == 0x6) return 2;
+  if ((c >> 4) == 0xE) return 3;
+  if ((c >> 3) == 0x1E) return 4;
+  return 1;  // invalid lead byte: treat as one unit (matches Python repair)
+}
+
+constexpr int kMaxWordBytes = 256;   // "#word#" buffer; longer words truncate
+constexpr int kMaxWordCps = 128;     // codepoint offsets within that buffer
+
+// Encode one word (already bracketed with '#') into out[0..k).
+inline void encode_word(const char* w, int64_t wlen, int32_t buckets,
+                        int32_t k, int32_t* out) {
+  // codepoint start offsets
+  int32_t offs[kMaxWordCps + 1];
+  int ncp = 0;
+  int64_t i = 0;
+  while (i < wlen && ncp < kMaxWordCps) {
+    offs[ncp++] = static_cast<int32_t>(i);
+    i += utf8_len(static_cast<unsigned char>(w[i]));
+  }
+  offs[ncp] = static_cast<int32_t>(i < wlen ? i : wlen);
+  if (ncp < 3) {  // word shorter than one trigram: hash the whole unit
+    out[0] = 1 + static_cast<int32_t>(fnv1a(w, offs[ncp]) %
+                                      static_cast<uint64_t>(buckets));
+    return;
+  }
+  int n_tg = ncp - 2;
+  if (n_tg > k) n_tg = k;
+  for (int t = 0; t < n_tg; ++t) {
+    const char* start = w + offs[t];
+    int64_t len = offs[t + 3 <= ncp ? t + 3 : ncp] - offs[t];
+    out[t] = 1 + static_cast<int32_t>(fnv1a(start, len) %
+                                      static_cast<uint64_t>(buckets));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out must hold max_words * k int32, pre-zeroed by the caller.
+void dpv_encode_trigrams(const char* text, int64_t text_len, int32_t buckets,
+                         int32_t max_words, int32_t k, int32_t* out) {
+  int64_t i = 0;
+  int32_t wi = 0;
+  char buf[kMaxWordBytes];
+  while (i < text_len && wi < max_words) {
+    while (i < text_len && is_space(text[i])) ++i;
+    if (i >= text_len) break;
+    int64_t start = i;
+    while (i < text_len && !is_space(text[i])) ++i;
+    int64_t wlen = i - start;
+    if (wlen > kMaxWordBytes - 2) wlen = kMaxWordBytes - 2;
+    buf[0] = '#';
+    std::memcpy(buf + 1, text + start, wlen);
+    buf[wlen + 1] = '#';
+    encode_word(buf, wlen + 2, buckets, k, out + wi * k);
+    ++wi;
+  }
+}
+
+// Batch API: texts are concatenated; lens[j] is the byte length of text j.
+// out holds n * max_words * k int32, pre-zeroed.
+void dpv_encode_trigrams_batch(const char* texts, const int64_t* lens,
+                               int64_t n, int32_t buckets, int32_t max_words,
+                               int32_t k, int32_t* out) {
+  int64_t off = 0;
+  const int64_t stride = static_cast<int64_t>(max_words) * k;
+  for (int64_t j = 0; j < n; ++j) {
+    dpv_encode_trigrams(texts + off, lens[j], buckets, max_words, k,
+                        out + j * stride);
+    off += lens[j];
+  }
+}
+
+}  // extern "C"

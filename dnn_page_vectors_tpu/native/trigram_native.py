@@ -1,0 +1,34 @@
+"""numpy-facing wrappers over the ctypes C++ trigram tokenizer."""
+from __future__ import annotations
+
+import ctypes
+from typing import Sequence
+
+import numpy as np
+
+from dnn_page_vectors_tpu.native import _lib
+
+
+def encode(text: str, buckets: int, max_words: int, k: int) -> np.ndarray:
+    out = np.zeros((max_words, k), dtype=np.int32)
+    data = text.encode("utf-8")
+    _lib.dpv_encode_trigrams(
+        data, len(data), buckets, max_words, k,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out
+
+
+def encode_batch(texts: Sequence[str], buckets: int, max_words: int,
+                 k: int) -> np.ndarray:
+    n = len(texts)
+    out = np.zeros((n, max_words, k), dtype=np.int32)
+    if n == 0:
+        return out
+    blobs = [t.encode("utf-8") for t in texts]
+    lens = np.asarray([len(b) for b in blobs], dtype=np.int64)
+    concat = b"".join(blobs)
+    _lib.dpv_encode_trigrams_batch(
+        concat, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        buckets, max_words, k,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out

@@ -1,0 +1,190 @@
+"""Pallas TPU flash attention for the transformer towers (encoder-only,
+bidirectional, padding-masked, optional additive bias for T5 relative
+positions).
+
+Why a kernel: naive attention materialises [B, H, L, S] scores in HBM; for
+long pages that array dominates HBM traffic. This kernel streams KV blocks
+through VMEM with an online softmax (running max m, denominator l, f32
+accumulator), so HBM sees only Q, K, V and the output — the standard
+flash-attention memory shape, written for the MXU (score and value matmuls
+with f32 accumulation) per /opt/skills/guides/pallas_guide.md.
+
+Autodiff: the backward pass recomputes attention with the plain-XLA
+reference implementation via jax.vjp (custom_vjp below). Training pays one
+extra fused forward; the 1B-page bulk-embed job (the headline workload,
+BASELINE.json:5) is forward-only and gets the full benefit.
+
+On CPU (tests, fake meshes) the kernel runs in interpret mode automatically.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        kv_mask: jnp.ndarray,
+                        bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Plain-XLA attention; the kernel's oracle and its backward path.
+
+    q: [B, H, L, Dh]; k, v: [B, H, S, Dh]; kv_mask: [B, S] (True = real
+    token); bias: optional [H, L, S] additive (T5 relative positions).
+    Returns [B, H, L, Dh] float32.
+    """
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhld,bhsd->bhls", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias[None].astype(jnp.float32)
+    s = jnp.where(kv_mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhls,bhsd->bhld", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, out_ref, *,
+                  block_kv: int):
+    # Block shapes (leading grid dims are 1):
+    # q_ref: [1,1,BQ,Dh]; k_ref/v_ref: [1,1,S,Dh]; mask_ref: [1,1,S] int32;
+    # bias_ref: [1,BQ,S] f32 or None; out_ref: [1,1,BQ,Dh] f32.
+    bq, dh = q_ref.shape[2], q_ref.shape[3]
+    s_len = k_ref.shape[2]
+    scale = 1.0 / np.sqrt(dh)
+    n_blocks = s_len // block_kv
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    k_all = k_ref[0, 0]
+    v_all = v_ref[0, 0]
+    mask_all = mask_ref[0, 0]                                # [S] int32
+    bias_all = None if bias_ref is None else bias_ref[0]
+
+    def body(i, carry):
+        acc, m_i, l_i = carry
+        start = i * block_kv
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k_all, start, block_kv, axis=0).astype(jnp.float32)  # [BKV, Dh]
+        v_blk = jax.lax.dynamic_slice_in_dim(
+            v_all, start, block_kv, axis=0).astype(jnp.float32)
+        s = jax.lax.dot_general(                             # [BQ, BKV]
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if bias_all is not None:
+            s = s + jax.lax.dynamic_slice_in_dim(bias_all, start, block_kv,
+                                                 axis=1)
+        mask = jax.lax.dynamic_slice_in_dim(mask_all, start, block_kv,
+                                            axis=0)          # [BKV] int32
+        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+
+        m_new = jnp.maximum(m_i, s.max(axis=1))              # [BQ]
+        p = jnp.exp(s - m_new[:, None])                      # [BQ, BKV]
+        alpha = jnp.exp(m_i - m_new)                         # [BQ]
+        l_new = alpha * l_i + p.sum(axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((bq, dh), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m_i, l_i = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    # fully-masked rows (padding queries): l == 0 -> emit zeros, not NaN
+    out_ref[0, 0] = acc / jnp.maximum(l_i, 1e-30)[:, None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    kv_mask: jnp.ndarray, bias: Optional[jnp.ndarray] = None,
+                    block_q: int = 128, block_kv: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    return _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv,
+                          interpret)
+
+
+def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
+    B, H, L, Dh = q.shape
+    S = k.shape[2]
+    if interpret is None:  # compiled on TPU, interpreted elsewhere
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, L)
+    block_kv = min(block_kv, S)
+    # pad L and S up to block multiples; padded KV is masked out, padded Q
+    # rows are sliced off after
+    pad_l, pad_s = (-L) % block_q, (-S) % block_kv
+    if pad_l:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_l), (0, 0)))
+    if pad_s:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, pad_s)))
+    if bias is not None and (pad_l or pad_s):
+        bias = jnp.pad(bias, ((0, 0), (0, pad_l), (0, pad_s)))
+    Lp, Sp = L + pad_l, S + pad_s
+
+    mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]         # [B, 1, S]
+
+    grid = (B, H, Lp // block_q)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, Sp, Dh), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, Sp, Dh), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, Sp), lambda b, h, i: (b, 0, 0)),
+    ]
+    args = [q, k, v, mask_i32]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_q, Sp), lambda b, h, i: (h, i, 0)))
+        args.append(bias.astype(jnp.float32))
+
+    def kernel(*refs):
+        if bias is not None:
+            q_ref, k_ref, v_ref, m_ref, b_ref, o_ref = refs
+        else:
+            q_ref, k_ref, v_ref, m_ref, o_ref = refs
+            b_ref = None
+        _flash_kernel(q_ref, k_ref, v_ref, m_ref, b_ref, o_ref,
+                      block_kv=block_kv)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, Dh),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Lp, Dh), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return out[:, :, :L]
+
+
+def _fwd(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
+    out = _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv,
+                         interpret)
+    return out, (q, k, v, kv_mask, bias)
+
+
+def _bwd(block_q, block_kv, interpret, res, g):
+    q, k, v, kv_mask, bias = res
+    # exact gradients by differentiating the reference implementation
+    # (one recomputed forward; see module docstring)
+    if bias is None:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: reference_attention(q_, k_, v_, kv_mask),
+            q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None, None
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, b_: reference_attention(q_, k_, v_, kv_mask, b_),
+        q, k, v, bias)
+    dq, dk, dv, db = vjp(g)
+    return dq, dk, dv, None, db
+
+
+flash_attention.defvjp(_fwd, _bwd)

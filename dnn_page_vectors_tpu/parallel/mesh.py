@@ -38,27 +38,33 @@ def multihost_init(coordinator: Optional[str] = None,
 
 
 def make_mesh(cfg: MeshConfig) -> Mesh:
+    """('data', 'model', 'seq') mesh; size-1 axes cost nothing and keep every
+    PartitionSpec in the codebase valid on every mesh."""
     devices = jax.devices()
     need = cfg.num_devices
     if len(devices) < need:
         raise ValueError(
-            f"mesh {cfg.data}x{cfg.model} needs {need} devices, "
+            f"mesh {cfg.data}x{cfg.model}x{cfg.seq} needs {need} devices, "
             f"have {len(devices)}; use fit_mesh_to_devices() for dev runs")
-    arr = np.asarray(devices[:need]).reshape(cfg.data, cfg.model)
-    return Mesh(arr, ("data", "model"))
+    arr = np.asarray(devices[:need]).reshape(cfg.data, cfg.model, cfg.seq)
+    return Mesh(arr, ("data", "model", "seq"))
 
 
 def fit_mesh_to_devices(cfg: MeshConfig,
                         devices: Optional[list] = None) -> MeshConfig:
     """Shrink a config's mesh to the devices actually present, preserving the
-    model axis when possible. Lets the v5p-64 configs run in the 1-chip
-    sandbox / 8-fake-device CPU tests unchanged."""
+    model and seq axes when possible. Lets the v5p-64 configs run in the
+    1-chip sandbox / 8-fake-device CPU tests unchanged."""
     n = len(devices if devices is not None else jax.devices())
     model = min(cfg.model, n)
     while n % model:
         model -= 1
-    data = min(cfg.data, n // model)
-    # round data down to a divisor of the remaining devices
-    while (n // model) % data:
+    rem = n // model
+    seq = min(cfg.seq, rem)
+    while rem % seq:
+        seq -= 1
+    rem //= seq
+    data = min(cfg.data, rem)
+    while rem % data:
         data -= 1
-    return MeshConfig(data=data, model=model)
+    return MeshConfig(data=data, model=model, seq=seq, strict=cfg.strict)

