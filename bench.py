@@ -1,11 +1,23 @@
-"""Benchmark: contrastive-training throughput in pages/sec/chip
-(the primary metric, BASELINE.json:2), run on whatever accelerator the
-environment provides (the driver runs this on one real TPU chip).
+"""Benchmark: contrastive-training + bulk-embed throughput in pages/sec/chip
+(the primary metric, BASELINE.json:2), with analytic-FLOPs MFU, run on
+whatever accelerator the environment provides (the driver runs this on one
+real TPU chip).
 
-Method: flagship two-tower BERT-mini (config 3 geometry), pre-tokenized
-batches resident on device (host tokenization is benched separately and is
-not the device metric), jit-compiled train step with donated state; warmup
-then timed steps. Prints ONE JSON line.
+Robustness (VERDICT round 1 #1): the TPU backend behind the tunnel can be
+transiently UNAVAILABLE or hang during init, which cost round 1 its only
+perf datapoint. This file is therefore a thin wrapper that runs the actual
+bench in a worker subprocess with a per-attempt timeout, retries with
+backoff while the backend is down, and on persistent failure prints ONE
+parseable JSON line with "value": null and an "error" field (rc 0) instead
+of a traceback (rc 1).
+
+Method (worker): flagship two-tower BERT-mini (config 3 geometry),
+pre-tokenized batches resident on device (host tokenization is benched by
+tests, not the device metric), jit-compiled train step with donated state;
+warmup then timed steps; then a forward-only encode_page sweep (the 1B-page
+bulk-embed workload, BASELINE.md:16). MFU comes from
+dnn_page_vectors_tpu/utils/flops.py analytic counts over the device's peak
+bf16 rate.
 
 vs_baseline: BASELINE.json publishes no reference numbers ("published": {},
 see BASELINE.md) — the ratio is computed against the most recent
@@ -17,9 +29,15 @@ import glob
 import json
 import os
 import re
+import subprocess
+import sys
 import time
 
-import numpy as np
+METRIC = "train_pages_per_sec_per_chip"
+UNIT = "pages/sec/chip"
+# Budget knobs (seconds); env-overridable so the driver can tighten them.
+ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "600"))
+TOTAL_BUDGET = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "1500"))
 
 
 def _previous_bench() -> float | None:
@@ -32,7 +50,8 @@ def _previous_bench() -> float | None:
         try:
             with open(path) as f:
                 rec = json.load(f)
-            cand = (int(m.group(1)), float(rec["value"]))
+            val = rec.get("parsed", rec)["value"] if "parsed" in rec else rec["value"]
+            cand = (int(m.group(1)), float(val))
         except Exception:
             continue
         if best is None or cand[0] > best[0]:
@@ -40,28 +59,43 @@ def _previous_bench() -> float | None:
     return None if best is None else best[1]
 
 
-def main() -> None:
+# ---------------------------------------------------------------------------
+# Worker: the actual measurement (runs in a subprocess).
+# ---------------------------------------------------------------------------
+
+def run_worker() -> None:
+    from dnn_page_vectors_tpu.utils.platform import honor_jax_platforms_env
+    honor_jax_platforms_env()
     import jax
 
     from dnn_page_vectors_tpu.config import get_config
     from dnn_page_vectors_tpu.train.loop import Trainer
+    from dnn_page_vectors_tpu.utils.flops import (
+        device_peak_flops, embed_flops_per_page, train_flops_per_pair)
 
-    n_dev = len(jax.devices())
+    devs = jax.devices()
+    n_dev = len(devs)
+    peak = device_peak_flops(devs[0])
+
+    # Scale knobs: defaults sized for one real TPU chip; the CPU smoke path
+    # (tests, debugging) shrinks via env.
+    per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "40"))
+    embed_iters = int(os.environ.get("BENCH_EMBED_ITERS", "60"))
+    batch = per_chip * n_dev
     cfg = get_config("bert_mini_v5p16", {
-        "data.num_pages": max(2_048, 256 * n_dev),
+        "data.num_pages": max(2_048, batch),
         "data.query_len": 16,
         "data.page_len": 64,
-        "train.batch_size": 256 * n_dev,
-        "train.steps": 40,
-        "train.log_every": 1_000_000,   # keep logging off the timed path
+        "train.batch_size": batch,
+        "train.steps": steps,
+        "train.log_every": 1_000_000,  # keep logging off the timed path
         "mesh.data": n_dev,
     })
     trainer = Trainer(cfg, workdir="/tmp/dnn_page_vectors_tpu_bench")
     state = trainer.init_state()
     step_fn = trainer.compiled_step(state)
 
-    # Pre-materialize a few batches on device: the metric is device
-    # training throughput; the host pipeline overlaps in production.
     from dnn_page_vectors_tpu.parallel.sharding import replicated
     it = iter(trainer.batches())
     batches = [next(it) for _ in range(4)]
@@ -78,16 +112,100 @@ def main() -> None:
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
-    pages_per_sec_per_chip = cfg.train.batch_size * timed_steps / dt / n_dev
+    train_pps_chip = batch * timed_steps / dt / n_dev
+    train_flops = train_flops_per_pair(cfg, batch)
+    train_mfu = (train_pps_chip * train_flops / peak) if peak else None
+
+    # ---- bulk-embed sweep (forward-only encode_page, device-resident) ----
+    from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+    embedder = BulkEmbedder(cfg, trainer.model, state.params,
+                            trainer.page_tok, trainer.mesh,
+                            query_tok=trainer.query_tok)
+    page_batch = batches[0]["page"]
+    out = embedder._encode_page(embedder.params, page_batch)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(embed_iters):
+        out = embedder._encode_page(embedder.params, page_batch)
+    jax.block_until_ready(out)
+    dt_e = time.perf_counter() - t0
+    embed_pps_chip = batch * embed_iters / dt_e / n_dev
+    embed_flops = embed_flops_per_page(cfg)
+    embed_mfu = (embed_pps_chip * embed_flops / peak) if peak else None
+
     prev = _previous_bench()
-    vs = pages_per_sec_per_chip / prev if prev else 1.0
-    print(json.dumps({
-        "metric": "train_pages_per_sec_per_chip",
-        "value": round(pages_per_sec_per_chip, 2),
-        "unit": "pages/sec/chip",
+    vs = train_pps_chip / prev if prev else 1.0
+    rec = {
+        "metric": METRIC,
+        "value": round(train_pps_chip, 2),
+        "unit": UNIT,
         "vs_baseline": round(vs, 4),
+        "train_mfu": round(train_mfu, 4) if train_mfu is not None else None,
+        "embed_pages_per_sec_per_chip": round(embed_pps_chip, 2),
+        "embed_mfu": round(embed_mfu, 4) if embed_mfu is not None else None,
+        "train_flops_per_pair": train_flops,
+        "embed_flops_per_page": embed_flops,
+        "n_devices": n_dev,
+        "device_kind": getattr(devs[0], "device_kind", "unknown"),
+        "peak_bf16_flops": peak,
+    }
+    print(json.dumps(rec))
+
+
+# ---------------------------------------------------------------------------
+# Wrapper: retry the worker while the backend is down; never leak a traceback
+# as the only output.
+# ---------------------------------------------------------------------------
+
+def _try_parse_last_json(stdout: str) -> dict | None:
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == METRIC:
+            return rec
+    return None
+
+
+def main() -> None:
+    deadline = time.time() + TOTAL_BUDGET
+    delay = 10.0
+    attempt = 0
+    last_err = "no attempts ran"
+    while True:
+        attempt += 1
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                capture_output=True, text=True,
+                timeout=min(ATTEMPT_TIMEOUT, max(60, deadline - time.time())),
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            )
+            rec = _try_parse_last_json(proc.stdout)
+            if proc.returncode == 0 and rec is not None:
+                print(json.dumps(rec))
+                return
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            last_err = " | ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            last_err = f"worker attempt {attempt} timed out after {ATTEMPT_TIMEOUT}s"
+        if time.time() + delay >= deadline:
+            break
+        time.sleep(delay)
+        delay = min(delay * 2, 120.0)
+    # Persistent failure: one parseable JSON line, rc 0 (VERDICT r1 #1).
+    print(json.dumps({
+        "metric": METRIC, "value": None, "unit": UNIT, "vs_baseline": None,
+        "error": last_err[-500:], "attempts": attempt,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        run_worker()
+    else:
+        main()

@@ -4,6 +4,7 @@
   python -m dnn_page_vectors_tpu.cli embed --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli eval  --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli mine  --config hardneg_v5p64
+  python -m dnn_page_vectors_tpu.cli pipeline --config hardneg_v5p64 --rounds 4
 
 Any config field is overridable with --set section.field=value; every flag
 round-trips through the Config dataclasses (SURVEY.md §5.6).
@@ -32,11 +33,18 @@ def _parse_overrides(pairs) -> Dict[str, object]:
 def _trainer(cfg):
     from dnn_page_vectors_tpu.train.loop import Trainer
     lookup = None
-    negs_path = os.path.join(cfg.workdir, "hard_negatives.npy")
-    if cfg.train.hard_negatives > 0 and os.path.exists(negs_path):
-        # close the mine -> train loop (config 4): feed mined negatives back
-        from dnn_page_vectors_tpu.mine.ann import HardNegatives
-        lookup = HardNegatives.load(negs_path)
+    if cfg.train.hard_negatives > 0:
+        negs_path = os.path.join(cfg.workdir, "hard_negatives.npy")
+        if os.path.exists(negs_path):
+            # close the mine -> train loop (config 4): feed mined negatives
+            from dnn_page_vectors_tpu.mine.ann import HardNegatives
+            lookup = HardNegatives.load(negs_path)
+        else:
+            import sys
+            print(f"WARNING: train.hard_negatives="
+                  f"{cfg.train.hard_negatives} but {negs_path} does not "
+                  "exist — training with in-batch negatives ONLY; run "
+                  "'mine' first (or check --workdir)", file=sys.stderr)
     return Trainer(cfg, hard_negative_lookup=lookup)
 
 
@@ -60,7 +68,9 @@ def _restore_or_init(cfg, trainer):
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="dnn_page_vectors_tpu")
     ap.add_argument("command", choices=["train", "embed", "eval", "mine",
-                                        "configs"])
+                                        "pipeline", "configs"])
+    ap.add_argument("--rounds", type=int, default=2,
+                    help="pipeline: train->embed->mine->train rounds")
     ap.add_argument("--config", default="cdssm_toy", choices=sorted(CONFIGS))
     ap.add_argument("--set", dest="overrides", action="append",
                     metavar="section.field=value")
@@ -87,6 +97,23 @@ def main(argv=None) -> None:
 
     trainer = _trainer(cfg)
     store_dir = os.path.join(cfg.workdir, "store")
+
+    if args.command == "pipeline":
+        # train -> embed -> mine -> continue-train rounds (SURVEY.md §4.4)
+        from dnn_page_vectors_tpu.train.pipeline import run_pipeline
+        state, mgr = _restore_or_init(cfg, trainer)
+        steps_per_round = (args.steps if args.steps is not None
+                           else max(1, cfg.train.steps // args.rounds))
+        with maybe_profile(args.profile, cfg.workdir):
+            out = run_pipeline(cfg, rounds=args.rounds,
+                               steps_per_round=steps_per_round,
+                               trainer=trainer, state=state,
+                               ckpt_manager=mgr)
+        mgr.save(int(out["state"].step), out["state"], wait=True)
+        mgr.close()
+        print(json.dumps({"rounds": args.rounds,
+                          "recalls": out["recalls"]}, sort_keys=True))
+        return
 
     if args.command == "train":
         state, mgr = _restore_or_init(cfg, trainer)

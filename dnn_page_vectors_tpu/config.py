@@ -27,6 +27,7 @@ class DataConfig:
     trigrams_per_word: int = 8       # K trigram ids kept per word (CDSSM)
     trigram_buckets: int = 16_384    # hash-bucket vocab for char trigrams
     vocab_size: int = 30_000         # word / subword vocab size
+    languages: int = 1               # >1: cross-lingual toy corpus (config 5)
     seed: int = 0
 
 
@@ -45,7 +46,11 @@ class ModelConfig:
     mlp_dim: int = 1024
     model_dim: int = 256
     dropout: float = 0.1
-    attention: str = "dense"         # dense | flash (Pallas kernel; long pages)
+    # dense | flash | ring. flash = Pallas kernel, O(L) HBM in forward AND
+    # backward for bert; the t5 variant's relative-position bias keeps a
+    # reference backward that re-materialises [B,H,L,S] when TRAINING (fine
+    # for short t5 pages; long-page training belongs to bert+flash/ring).
+    attention: str = "dense"
     shared_towers: bool = False      # share params between query/page towers
     dtype: str = "bfloat16"          # compute dtype on MXU
 
@@ -211,7 +216,7 @@ def mt5_multilingual() -> Config:
         name="mt5_multilingual",
         data=DataConfig(tokenizer="sentencepiece", corpus="toy",
                         num_pages=10_000_000, vocab_size=250_112,
-                        page_len=128),
+                        page_len=128, languages=4),
         model=ModelConfig(encoder="t5", num_layers=12, num_heads=12,
                           model_dim=768, mlp_dim=2048, out_dim=768),
         mesh=MeshConfig(data=4, model=2),

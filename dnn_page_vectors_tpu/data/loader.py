@@ -31,17 +31,30 @@ def build_corpus(cfg: Config):
     d = cfg.data
     if d.corpus == "toy":
         return ToyCorpus(num_pages=d.num_pages, seed=d.seed,
-                         page_len=d.page_len, query_len=d.query_len)
+                         page_len=d.page_len, query_len=d.query_len,
+                         languages=d.languages)
     if d.corpus.startswith("jsonl:"):
         return JsonlCorpus(d.corpus[len("jsonl:"):])
     raise ValueError(f"unknown corpus {d.corpus!r} (want 'toy' or 'jsonl:<path>')")
+
+
+def _corpus_fingerprint(corpus) -> str:
+    fp = getattr(corpus, "fingerprint", None)
+    return fp() if callable(fp) else f"{type(corpus).__name__}:{corpus.num_pages}"
 
 
 def build_tokenizer(cfg: Config, corpus, cache_dir: Optional[str] = None):
     """Builds (query_tok, page_tok). Trained vocabs (word/subword) are cached
     under cache_dir so later embed/eval/mine runs reuse the EXACT vocab the
     model was trained with — page vectors are only comparable across runs if
-    token ids are (vector-store reproducibility, SURVEY.md §3 #20)."""
+    token ids are (vector-store reproducibility, SURVEY.md §3 #20).
+
+    Honesty contract (VERDICT r1 #3): the built tokenizer's vocab_size must
+    EQUAL config.data.vocab_size — training raises rather than silently
+    clamping, and a cached vocab is only reused when its recorded
+    (vocab_size, corpus fingerprint) provenance matches the current config
+    (ADVICE r1: stale-cache divergence).
+    """
     d = cfg.data
     if d.tokenizer == "trigram":   # stateless hashing: nothing to cache
         q = TrigramTokenizer(d.trigram_buckets, max_words=d.query_len,
@@ -51,26 +64,40 @@ def build_tokenizer(cfg: Config, corpus, cache_dir: Optional[str] = None):
         return q, p
     cache = (os.path.join(cache_dir, f"tokenizer_{d.tokenizer}.json")
              if cache_dir else None)
+    meta = {"vocab_size": d.vocab_size,
+            "corpus": _corpus_fingerprint(corpus)}
     if d.tokenizer == "word":
+        tok = None
         if cache and os.path.exists(cache):
             tok = WordTokenizer.load(cache)
-        else:
+            if tok.meta != meta:   # stale: config/corpus changed since save
+                tok = None
+        if tok is None:
             tok = WordTokenizer.train(
-                corpus.all_texts(limit=min(corpus.num_pages, 20_000)),
-                vocab_size=d.vocab_size, max_words=d.page_len)
+                corpus.all_texts(), vocab_size=d.vocab_size,
+                max_words=d.page_len, strict_vocab=True)
+            tok.meta = meta
             if cache:
                 tok.save(cache)
         q = WordTokenizer(tok.vocab, max_words=d.query_len)
         return q, tok
     if d.tokenizer in ("wordpiece", "sentencepiece"):
+        tok = None
         if cache and os.path.exists(cache):
             tok = SubwordTokenizer.load(cache)
             tok.max_tokens = d.page_len
-        else:
+            if tok.meta != meta:
+                tok = None
+        if tok is None:
+            # sample size scales with the requested vocab: merge capacity is
+            # bounded by unique-word count (~word-sample/27 on the toy
+            # corpus), and a 250k-piece vocab needs a far bigger sample than
+            # the 2M-word default that suits 30k
             tok = SubwordTokenizer.train(
-                corpus.all_texts(limit=min(corpus.num_pages, 5_000)),
-                vocab_size=min(d.vocab_size, 8_192), style=d.tokenizer,
-                max_tokens=d.page_len)
+                corpus.all_texts(), vocab_size=d.vocab_size,
+                style=d.tokenizer, max_tokens=d.page_len, strict_vocab=True,
+                max_train_words=max(2_000_000, 60 * d.vocab_size))
+            tok.meta = meta
             if cache:
                 tok.save(cache)
         q = SubwordTokenizer(tok.vocab, style=tok.style, max_tokens=d.query_len)
@@ -81,13 +108,24 @@ def build_tokenizer(cfg: Config, corpus, cache_dir: Optional[str] = None):
 class TrainBatcher:
     """Deterministic shuffled (query, page) training batches.
 
-    Yields {"query": [B, ...], "page": [B, ...], "page_id": [B]} numpy
+    Yields {"query": [b, ...], "page": [b, ...], "page_id": [b]} numpy
     batches; static shapes so the jitted step compiles once.
+
+    Multi-host (VERDICT r1 #6): every process derives the SAME global batch
+    ids from the shared seed, but tokenizes/materialises ONLY its
+    `process_index`-th contiguous slice (b = batch_size / process_count
+    rows) — host work and memory stay O(global batch / hosts). The prefetch
+    layer reassembles the global array with
+    jax.make_array_from_process_local_data. Contiguous slicing matches the
+    mesh 'data' axis order because make_mesh lays devices out in
+    jax.devices() order (process-major).
     """
 
     def __init__(self, corpus: ToyCorpus, query_tok, page_tok,
                  batch_size: int, seed: int = 0, start_step: int = 0,
-                 hard_negative_lookup: Optional[Callable[[np.ndarray], np.ndarray]] = None):
+                 hard_negative_lookup: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
         if batch_size > corpus.num_pages:
             raise ValueError(
                 f"batch_size {batch_size} > corpus size {corpus.num_pages}: "
@@ -102,6 +140,14 @@ class TrainBatcher:
         self.start_step = start_step
         # maps [B] gold page ids -> [B, H] hard-negative page ids (mine/ann.py)
         self.hard_negative_lookup = hard_negative_lookup
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        if batch_size % self.process_count:
+            raise ValueError(
+                f"batch_size {batch_size} must divide process_count "
+                f"{self.process_count} (contiguous per-host slices)")
 
     @property
     def steps_per_epoch(self) -> int:
@@ -111,12 +157,14 @@ class TrainBatcher:
         n = self.corpus.num_pages
         epoch = self.start_step // self.steps_per_epoch
         skip = self.start_step % self.steps_per_epoch
+        local = self.batch_size // self.process_count
+        lo = self.process_index * local
         while True:
             rng = np.random.default_rng(self.seed + epoch)
             order = rng.permutation(n)
             for b in range(skip, self.steps_per_epoch):
                 s = b * self.batch_size
-                ids = order[s: s + self.batch_size]
+                ids = order[s + lo: s + lo + local]   # this process's slice
                 yield self._materialize(ids)
             skip = 0
             epoch += 1
@@ -165,6 +213,10 @@ def prefetch_to_device(it: Iterator[Batch], sharding: Optional[Any] = None,
     consumer (a swallowed tokenizer crash must not look like end-of-stream —
     embed_corpus would record a short shard as complete). Abandoning the
     generator (GeneratorExit) unblocks and stops the producer thread.
+
+    Multi-process: upstream batchers yield only this process's slice;
+    jax.make_array_from_process_local_data assembles the global sharded
+    array (each host feeds exactly its addressable shards, VERDICT r1 #6).
     """
     q: "queue_mod.Queue[Any]" = queue_mod.Queue(maxsize=depth)
     stop = threading.Event()
@@ -198,9 +250,15 @@ def prefetch_to_device(it: Iterator[Batch], sharding: Optional[Any] = None,
 
     buf: collections.deque[Any] = collections.deque()
 
+    multiprocess = jax.process_count() > 1
+
     def _put(batch: Batch) -> Any:
         if sharding is None:
             return jax.device_put(batch)
+        if multiprocess:
+            return jax.tree_util.tree_map(
+                lambda arr: jax.make_array_from_process_local_data(
+                    sharding, np.asarray(arr)), batch)
         return jax.device_put(batch, jax.tree_util.tree_map(
             lambda _: sharding, batch))
 

@@ -48,7 +48,15 @@ class TrigramTokenizer:
         if use_native:
             try:  # C++ fast path (builds on first import); Python fallback
                 from dnn_page_vectors_tpu.native import trigram_native
-                self._native = trigram_native
+                # Self-check: the two paths must agree bit-exactly or the
+                # vector store is not reproducible across hosts (ADVICE r1).
+                # The probe covers Unicode whitespace (NBSP, LS) and a word
+                # longer than any fixed C buffer.
+                probe = "ab cd ef " + "x" * 300 + " fin"
+                native = trigram_native.encode(probe, self.buckets,
+                                               self.max_words, self.k)
+                if (native == self._encode_py(probe)).all():
+                    self._native = trigram_native
             except Exception:
                 self._native = None
 

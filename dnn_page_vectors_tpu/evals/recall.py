@@ -1,8 +1,11 @@
 """Retrieval eval: Recall@10 query->page (SURVEY.md §3 #22; BASELINE.json:2).
 
-Shares the chunked on-device top-k kernel with the ANN miner (call stack
-§4.3): scores = Q @ P.T on the MXU, running top-k via lax.scan, host-side
-comparison against gold labels.
+Shares the top-k substrate with the ANN miner (call stack §4.3): the store
+streams shard-by-shard through `ops.topk.topk_over_store`, each shard
+row-sharded over the mesh 'data' axis, scored on the MXU, per-shard top-k
+all-gathered over ICI, running merge on host — so eval memory stays
+O(one store shard) no matter the corpus size (the 1B-page requirement,
+BASELINE.md:16; VERDICT r1 #2).
 """
 from __future__ import annotations
 
@@ -14,14 +17,16 @@ import numpy as np
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
 from dnn_page_vectors_tpu.infer.vector_store import VectorStore
 from dnn_page_vectors_tpu.data.toy import ToyCorpus
-from dnn_page_vectors_tpu.ops.topk import chunked_topk
+from dnn_page_vectors_tpu.ops.topk import chunked_topk, topk_over_store
 
 
 def recall_at_k(query_vecs: np.ndarray, page_ids: np.ndarray,
                 page_vecs: np.ndarray, gold_ids: np.ndarray,
                 k: int = 10, query_batch: int = 1024,
                 chunk: int = 8192) -> float:
-    """Fraction of queries whose gold page id is in the top-k.
+    """Fraction of queries whose gold page id is in the top-k, for
+    in-memory page vectors (single device). The store-scale path is
+    `recall_from_store`.
 
     query_vecs [Nq, D] and page_vecs [N, D] must be L2-normalized (the
     store's invariant); page_ids maps store rows -> page ids.
@@ -40,6 +45,18 @@ def recall_at_k(query_vecs: np.ndarray, page_ids: np.ndarray,
     return hits / max(nq, 1)
 
 
+def recall_from_store(query_vecs: np.ndarray, store: VectorStore,
+                      gold_ids: np.ndarray, mesh, k: int = 10,
+                      query_batch: int = 1024, chunk: int = 8192) -> float:
+    """Recall@k streaming the store through the sharded cross-shard merge —
+    never materializes more than one store shard."""
+    _, retrieved = topk_over_store(
+        np.asarray(query_vecs, np.float32), store, mesh, k=k,
+        chunk=chunk, query_batch=query_batch)
+    hits = (retrieved == gold_ids[:, None]).any(axis=1).sum()
+    return float(hits) / max(query_vecs.shape[0], 1)
+
+
 def evaluate_recall(embedder: BulkEmbedder, corpus: ToyCorpus,
                     store: VectorStore, num_queries: Optional[int] = None,
                     k: int = 10) -> Tuple[float, int]:
@@ -48,7 +65,6 @@ def evaluate_recall(embedder: BulkEmbedder, corpus: ToyCorpus,
     nq = min(num_queries or embedder.cfg.eval.eval_queries, corpus.num_pages)
     query_vecs = embedder.embed_texts(
         [corpus.query_text(i) for i in range(nq)], tower="query")
-    page_ids, page_vecs = store.load_all()
     gold = np.arange(nq, dtype=np.int64)
-    r = recall_at_k(query_vecs, page_ids, page_vecs, gold, k=k)
+    r = recall_from_store(query_vecs, store, gold, embedder.mesh, k=k)
     return r, nq

@@ -104,11 +104,23 @@ class BulkEmbedder:
             ids_acc, vec_acc = [], []
             batches = iter_corpus_batches(corpus, self.page_tok, bs,
                                           start=start, stop=stop)
+            # Output is double-buffered (VERDICT r1 #8): dispatch batch i's
+            # encode (async under JAX's deferred execution), THEN materialize
+            # batch i-1's vectors — the device->host copy of the previous
+            # batch overlaps the current batch's compute instead of
+            # serializing after it.
+            pending = None
             for batch in prefetch_to_device(batches,
                                             sharding=batch_sharding(self.mesh)):
                 vecs = self._encode_page(self.params, batch["page"])
-                ids_acc.append(np.asarray(batch["page_id"]))
-                vec_acc.append(np.asarray(vecs))
+                if pending is not None:
+                    ids_acc.append(np.asarray(pending[0]))
+                    vec_acc.append(np.asarray(pending[1]))
+                    pages += int((ids_acc[-1] >= 0).sum())
+                pending = (batch["page_id"], vecs)
+            if pending is not None:
+                ids_acc.append(np.asarray(pending[0]))
+                vec_acc.append(np.asarray(pending[1]))
                 pages += int((ids_acc[-1] >= 0).sum())
             store.write_shard(si, np.concatenate(ids_acc),
                               np.concatenate(vec_acc))

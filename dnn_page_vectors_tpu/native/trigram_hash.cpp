@@ -6,13 +6,18 @@
 // loader layer, exposed to Python via ctypes (no pybind11 in the image).
 //
 // Semantics mirror trigram.py exactly (tests assert bit-equality):
-//   * words split on ASCII whitespace
+//   * words split on UNICODE whitespace — the same set as Python's
+//     str.split() (ASCII ws, U+1C-1F, NEL, NBSP, U+1680, U+2000-200A,
+//     LS/PS, U+202F, U+205F, U+3000) — so hosts with and without the
+//     built .so tokenize multilingual text identically (ADVICE r1)
 //   * per word: "#" + word + "#", trigrams over UTF-8 *codepoints*
 //   * id = 1 + FNV1a64(utf8 bytes of the trigram) % buckets, 0 = pad
-//   * at most `k` trigrams per word, at most `max_words` words.
+//   * at most `k` trigrams per word, at most `max_words` words; words are
+//     never length-truncated (Python doesn't truncate either).
 
 #include <cstdint>
 #include <cstring>
+#include <string>
 
 namespace {
 
@@ -28,11 +33,6 @@ inline uint64_t fnv1a(const char* data, int64_t n) {
   return h;
 }
 
-inline bool is_space(char c) {
-  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
-         c == '\v';
-}
-
 // Number of bytes in the UTF-8 sequence starting at lead byte `c`.
 inline int utf8_len(unsigned char c) {
   if (c < 0x80) return 1;
@@ -42,8 +42,43 @@ inline int utf8_len(unsigned char c) {
   return 1;  // invalid lead byte: treat as one unit (matches Python repair)
 }
 
-constexpr int kMaxWordBytes = 256;   // "#word#" buffer; longer words truncate
-constexpr int kMaxWordCps = 128;     // codepoint offsets within that buffer
+// Decode the codepoint at s (n bytes left); *len gets bytes consumed.
+// Invalid sequences decode as the single lead byte (inputs come from
+// Python str.encode("utf-8") and are always valid in practice).
+inline uint32_t decode_cp(const char* s, int64_t n, int* len) {
+  unsigned char c = static_cast<unsigned char>(s[0]);
+  int l = utf8_len(c);
+  if (l == 1 || l > n) { *len = 1; return c; }
+  uint32_t cp = c & (0xFF >> (l + 1));
+  for (int i = 1; i < l; ++i) {
+    unsigned char cc = static_cast<unsigned char>(s[i]);
+    if ((cc >> 6) != 0x2) { *len = 1; return c; }
+    cp = (cp << 6) | (cc & 0x3F);
+  }
+  *len = l;
+  return cp;
+}
+
+// Python str.split() whitespace = Unicode WSpace (str.isspace()).
+inline bool is_space_cp(uint32_t cp) {
+  switch (cp) {
+    case 0x09: case 0x0A: case 0x0B: case 0x0C: case 0x0D: case 0x20:
+    case 0x1C: case 0x1D: case 0x1E: case 0x1F:
+    case 0x85: case 0xA0: case 0x1680:
+    case 0x2000: case 0x2001: case 0x2002: case 0x2003: case 0x2004:
+    case 0x2005: case 0x2006: case 0x2007: case 0x2008: case 0x2009:
+    case 0x200A: case 0x2028: case 0x2029: case 0x202F: case 0x205F:
+    case 0x3000:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Only the first k+2 codepoints of "#word#" can contribute trigrams, so
+// offset bookkeeping is bounded even for unbounded word lengths. 512
+// covers any practical k (trigram.py defaults k=8).
+constexpr int kMaxWordCps = 512;
 
 // Encode one word (already bracketed with '#') into out[0..k).
 inline void encode_word(const char* w, int64_t wlen, int32_t buckets,
@@ -81,18 +116,24 @@ void dpv_encode_trigrams(const char* text, int64_t text_len, int32_t buckets,
                          int32_t max_words, int32_t k, int32_t* out) {
   int64_t i = 0;
   int32_t wi = 0;
-  char buf[kMaxWordBytes];
+  std::string buf;  // reused "#word#" buffer; grows to the longest word
   while (i < text_len && wi < max_words) {
-    while (i < text_len && is_space(text[i])) ++i;
+    int cl;
+    while (i < text_len &&
+           is_space_cp(decode_cp(text + i, text_len - i, &cl))) {
+      i += cl;
+    }
     if (i >= text_len) break;
     int64_t start = i;
-    while (i < text_len && !is_space(text[i])) ++i;
-    int64_t wlen = i - start;
-    if (wlen > kMaxWordBytes - 2) wlen = kMaxWordBytes - 2;
-    buf[0] = '#';
-    std::memcpy(buf + 1, text + start, wlen);
-    buf[wlen + 1] = '#';
-    encode_word(buf, wlen + 2, buckets, k, out + wi * k);
+    while (i < text_len &&
+           !is_space_cp(decode_cp(text + i, text_len - i, &cl))) {
+      i += cl;
+    }
+    buf.assign(1, '#');
+    buf.append(text + start, static_cast<size_t>(i - start));
+    buf.push_back('#');
+    encode_word(buf.data(), static_cast<int64_t>(buf.size()), buckets, k,
+                out + wi * k);
     ++wi;
   }
 }

@@ -9,12 +9,18 @@ accumulator), so HBM sees only Q, K, V and the output — the standard
 flash-attention memory shape, written for the MXU (score and value matmuls
 with f32 accumulation) per /opt/skills/guides/pallas_guide.md.
 
-Autodiff: the backward pass recomputes attention with the plain-XLA
-reference implementation via jax.vjp (custom_vjp below). Training pays one
-extra fused forward; the 1B-page bulk-embed job (the headline workload,
-BASELINE.json:5) is forward-only and gets the full benefit.
+Autodiff (VERDICT r1 #7): the backward is ALSO Pallas — two kernels that
+recompute attention probabilities per block from the saved log-sum-exp
+(one for dq gridded over Q blocks, one for dk/dv gridded over KV blocks),
+so long-page TRAINING keeps the flash memory shape too; no [B, H, L, S]
+tensor exists in forward or backward. Exception: with a T5 relative-
+position `bias` the backward falls back to differentiating the XLA
+reference (dbias needs a cross-batch reduction the sequential-grid kernel
+layout doesn't cover yet); that path re-materialises [B, H, L, S] during
+training and model.attention='flash' documents the caveat — T5 pages are
+short (config 5), the long-page SP family is BERT.
 
-On CPU (tests, fake meshes) the kernel runs in interpret mode automatically.
+On CPU (tests, fake meshes) the kernels run in interpret mode automatically.
 """
 from __future__ import annotations
 
@@ -32,7 +38,7 @@ _NEG_INF = -1e30
 def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         kv_mask: jnp.ndarray,
                         bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Plain-XLA attention; the kernel's oracle and its backward path.
+    """Plain-XLA attention; the kernel's oracle (and the bias-path backward).
 
     q: [B, H, L, Dh]; k, v: [B, H, S, Dh]; kv_mask: [B, S] (True = real
     token); bias: optional [H, L, S] additive (T5 relative positions).
@@ -49,11 +55,12 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       preferred_element_type=jnp.float32)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, out_ref, *,
-                  block_kv: int):
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, out_ref, lse_ref,
+                  *, block_kv: int):
     # Block shapes (leading grid dims are 1):
     # q_ref: [1,1,BQ,Dh]; k_ref/v_ref: [1,1,S,Dh]; mask_ref: [1,1,S] int32;
-    # bias_ref: [1,BQ,S] f32 or None; out_ref: [1,1,BQ,Dh] f32.
+    # bias_ref: [1,BQ,S] f32 or None; out_ref: [1,1,BQ,Dh] f32;
+    # lse_ref: [1,1,BQ] f32 (log-sum-exp of scaled scores, for the backward).
     bq, dh = q_ref.shape[2], q_ref.shape[3]
     s_len = k_ref.shape[2]
     scale = 1.0 / np.sqrt(dh)
@@ -95,8 +102,104 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, out_ref, *,
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc, m_i, l_i = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
-    # fully-masked rows (padding queries): l == 0 -> emit zeros, not NaN
+    # Fully-masked rows (all scores _NEG_INF): m stays _NEG_INF, p == 1
+    # everywhere, l == S — the output is mean(V), matching the reference's
+    # uniform softmax over _NEG_INF scores (downstream pooling masks those
+    # rows out; do NOT rely on zeros here). The epsilon only guards l == 0,
+    # which cannot occur for S >= 1.
     out_ref[0, 0] = acc / jnp.maximum(l_i, 1e-30)[:, None]
+    lse_ref[0, 0] = m_i + jnp.log(jnp.maximum(l_i, 1e-30))
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref, lse_ref,
+                     delta_ref, dq_ref, *, block_kv: int):
+    # Grid (B, H, Lp/BQ). Per program: one Q block vs all KV blocks.
+    bq, dh = q_ref.shape[2], q_ref.shape[3]
+    s_len = k_ref.shape[2]
+    scale = 1.0 / np.sqrt(dh)
+    n_blocks = s_len // block_kv
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    g = g_ref[0, 0].astype(jnp.float32)                       # [BQ, Dh]
+    lse = lse_ref[0, 0]                                       # [BQ]
+    delta = delta_ref[0, 0]                                   # [BQ]
+    k_all = k_ref[0, 0]
+    v_all = v_ref[0, 0]
+    mask_all = mask_ref[0, 0]
+
+    def body(i, acc):
+        start = i * block_kv
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k_all, start, block_kv, axis=0).astype(jnp.float32)
+        v_blk = jax.lax.dynamic_slice_in_dim(
+            v_all, start, block_kv, axis=0).astype(jnp.float32)
+        s = scale * jax.lax.dot_general(                      # [BQ, BKV]
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        mask = jax.lax.dynamic_slice_in_dim(mask_all, start, block_kv, axis=0)
+        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                         # [BQ, BKV]
+        dp = jax.lax.dot_general(                             # g @ v^T
+            g, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])                        # [BQ, BKV]
+        return acc + jax.lax.dot_general(                     # ds @ k
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(0, n_blocks,
+                            body, jnp.zeros((bq, dh), jnp.float32))
+    dq_ref[0, 0] = scale * acc
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref, lse_ref,
+                      delta_ref, dk_ref, dv_ref, *, block_q: int):
+    # Grid (B, H, Sp/BKV). Per program: one KV block vs all Q blocks.
+    bkv, dh = k_ref.shape[2], k_ref.shape[3]
+    l_len = q_ref.shape[2]
+    scale = 1.0 / np.sqrt(dh)
+    n_blocks = l_len // block_q
+
+    k_blk = k_ref[0, 0].astype(jnp.float32)                   # [BKV, Dh]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    mask = mask_ref[0, 0]                                     # [BKV]
+    q_all = q_ref[0, 0]
+    g_all = g_ref[0, 0]
+    lse_all = lse_ref[0, 0]                                   # [L]
+    delta_all = delta_ref[0, 0]
+
+    def body(i, carry):
+        dk, dv = carry
+        start = i * block_q
+        q_blk = jax.lax.dynamic_slice_in_dim(
+            q_all, start, block_q, axis=0).astype(jnp.float32)  # [BQ, Dh]
+        g_blk = jax.lax.dynamic_slice_in_dim(
+            g_all, start, block_q, axis=0).astype(jnp.float32)
+        lse = jax.lax.dynamic_slice_in_dim(lse_all, start, block_q, axis=0)
+        delta = jax.lax.dynamic_slice_in_dim(delta_all, start, block_q,
+                                             axis=0)
+        s = scale * jax.lax.dot_general(                      # [BQ, BKV]
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                         # [BQ, BKV]
+        dv = dv + jax.lax.dot_general(                        # p^T @ g
+            p, g_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(                             # g @ v^T
+            g_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])                        # [BQ, BKV]
+        dk = dk + jax.lax.dot_general(                        # ds^T @ q
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bkv, dh), jnp.float32)
+    dv0 = jnp.zeros((bkv, dh), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, n_blocks, body, (dk0, dv0))
+    dk_ref[0, 0] = scale * dk
+    dv_ref[0, 0] = dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
@@ -104,19 +207,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     kv_mask: jnp.ndarray, bias: Optional[jnp.ndarray] = None,
                     block_q: int = 128, block_kv: int = 128,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
-    return _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv,
-                          interpret)
+    out, _ = _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv,
+                            interpret)
+    return out
 
 
-def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
+def _pad_inputs(q, k, v, kv_mask, bias, block_q, block_kv):
     B, H, L, Dh = q.shape
     S = k.shape[2]
-    if interpret is None:  # compiled on TPU, interpreted elsewhere
-        interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, L)
     block_kv = min(block_kv, S)
-    # pad L and S up to block multiples; padded KV is masked out, padded Q
-    # rows are sliced off after
     pad_l, pad_s = (-L) % block_q, (-S) % block_kv
     if pad_l:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_l), (0, 0)))
@@ -126,7 +226,17 @@ def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
         kv_mask = jnp.pad(kv_mask, ((0, 0), (0, pad_s)))
     if bias is not None and (pad_l or pad_s):
         bias = jnp.pad(bias, ((0, 0), (0, pad_l), (0, pad_s)))
-    Lp, Sp = L + pad_l, S + pad_s
+    return q, k, v, kv_mask, bias, block_q, block_kv, L, S
+
+
+def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
+    """Returns (out [B,H,L,Dh] f32, lse [B,H,L] f32)."""
+    if interpret is None:  # compiled on TPU, interpreted elsewhere
+        interpret = jax.default_backend() != "tpu"
+    (q, k, v, kv_mask, bias, block_q, block_kv, L, S) = _pad_inputs(
+        q, k, v, kv_mask, bias, block_q, block_kv)
+    B, H, Lp, Dh = q.shape
+    Sp = k.shape[2]
 
     mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]         # [B, 1, S]
 
@@ -145,41 +255,101 @@ def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
 
     def kernel(*refs):
         if bias is not None:
-            q_ref, k_ref, v_ref, m_ref, b_ref, o_ref = refs
+            q_ref, k_ref, v_ref, m_ref, b_ref, o_ref, l_ref = refs
         else:
-            q_ref, k_ref, v_ref, m_ref, o_ref = refs
+            q_ref, k_ref, v_ref, m_ref, o_ref, l_ref = refs
             b_ref = None
-        _flash_kernel(q_ref, k_ref, v_ref, m_ref, b_ref, o_ref,
+        _flash_kernel(q_ref, k_ref, v_ref, m_ref, b_ref, o_ref, l_ref,
                       block_kv=block_kv)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, block_q, Dh),
-                               lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Lp, Dh), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lp, Dh), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Lp), jnp.float32),
+        ],
         interpret=interpret,
     )(*args)
-    return out[:, :, :L]
+    return out[:, :, :L], lse[:, :, :L]
+
+
+def _flash_backward(q, k, v, kv_mask, g, out, lse, block_q, block_kv,
+                    interpret):
+    """Pallas dq/dk/dv with per-block recompute from the saved lse."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    in_dtypes = (q.dtype, k.dtype, v.dtype)
+    (q, k, v, kv_mask, _, block_q, block_kv, L, S) = _pad_inputs(
+        q, k, v, kv_mask, None, block_q, block_kv)
+    B, H, Lp, Dh = q.shape
+    Sp = k.shape[2]
+    pad_l = Lp - L
+
+    # delta_i = sum_d dO_i * O_i (the softmax-jacobian row term)
+    delta = jnp.einsum("bhld,bhld->bhl", g.astype(jnp.float32), out)
+    if pad_l:
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, pad_l), (0, 0)))
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_l)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_l)))
+    mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]
+
+    qspec = pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0))
+    kfull = pl.BlockSpec((1, 1, Sp, Dh), lambda b, h, i: (b, h, 0, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_kv=block_kv),
+        grid=(B, H, Lp // block_q),
+        in_specs=[qspec, kfull, kfull,
+                  pl.BlockSpec((1, 1, Sp), lambda b, h, i: (b, 0, 0)),
+                  qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Lp, Dh), jnp.float32),
+        interpret=interpret,
+    )(q, k, v, mask_i32, g, lse, delta)
+
+    kvspec = pl.BlockSpec((1, 1, block_kv, Dh), lambda b, h, j: (b, h, j, 0))
+    qfull = pl.BlockSpec((1, 1, Lp, Dh), lambda b, h, j: (b, h, 0, 0))
+    rowfull = pl.BlockSpec((1, 1, Lp), lambda b, h, j: (b, h, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q),
+        grid=(B, H, Sp // block_kv),
+        in_specs=[qfull, kvspec, kvspec,
+                  pl.BlockSpec((1, 1, block_kv), lambda b, h, j: (b, 0, j)),
+                  qfull, rowfull, rowfull],
+        out_specs=[kvspec, kvspec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Sp, Dh), jnp.float32),
+                   jax.ShapeDtypeStruct((B, H, Sp, Dh), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, mask_i32, g, lse, delta)
+
+    dq = dq[:, :, :L].astype(in_dtypes[0])
+    dk = dk[:, :, :S].astype(in_dtypes[1])
+    dv = dv[:, :, :S].astype(in_dtypes[2])
+    return dq, dk, dv
 
 
 def _fwd(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
-    out = _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv,
-                         interpret)
-    return out, (q, k, v, kv_mask, bias)
+    out, lse = _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv,
+                              interpret)
+    return out, (q, k, v, kv_mask, bias, out, lse)
 
 
 def _bwd(block_q, block_kv, interpret, res, g):
-    q, k, v, kv_mask, bias = res
-    # exact gradients by differentiating the reference implementation
-    # (one recomputed forward; see module docstring)
+    q, k, v, kv_mask, bias, out, lse = res
     if bias is None:
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: reference_attention(q_, k_, v_, kv_mask),
-            q, k, v)
-        dq, dk, dv = vjp(g)
+        dq, dk, dv = _flash_backward(q, k, v, kv_mask, g, out, lse,
+                                     block_q, block_kv, interpret)
         return dq, dk, dv, None, None
+    # T5 bias path: dbias needs a cross-batch reduction; fall back to
+    # differentiating the reference (re-materialises [B,H,L,S] — see
+    # module docstring caveat; T5 pages are short).
     _, vjp = jax.vjp(
         lambda q_, k_, v_, b_: reference_attention(q_, k_, v_, kv_mask, b_),
         q, k, v, bias)

@@ -1,44 +1,47 @@
-"""Chunked brute-force top-k over page vectors (SURVEY.md §3 #21-22).
+"""Brute-force top-k over page vectors (SURVEY.md §3 #21-22).
 
 This is the TPU-native ANN substrate: instead of a CPU FAISS index, score
 queries against the corpus with MXU matmuls and keep a running top-k via
 `lax.scan` + `lax.top_k` — HBM never holds more than one [Bq, chunk] score
 block, so the corpus side streams at HBM bandwidth while compute stays on
-the MXU. Exact (brute-force) search; at 1B pages it shards over the mesh
-'data' axis with a final cross-shard merge (see mine/ann.py, evals/recall.py).
+the MXU. Exact (brute-force) search, three tiers:
+
+  * `chunked_topk`   — one device, pages resident in HBM.
+  * `sharded_topk`   — pages row-sharded over the mesh 'data' axis; each
+    device scores its slice, per-shard top-k candidates are all-gathered
+    over ICI and merged. HBM per device holds only N/n_data rows.
+  * `topk_over_store`— streams vector-store shards from disk through
+    `sharded_topk`, merging on host. Peak footprint is ONE store shard
+    spread over the mesh, so 1B-page retrieval (BASELINE.md:16) runs on a
+    fixed memory budget. Used by evals/recall.py and mine/ann.py.
 """
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Dict, Iterable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
 
 
-@partial(jax.jit, static_argnames=("k", "chunk"))
-def chunked_topk(q: jnp.ndarray, pages: jnp.ndarray, k: int = 10,
-                 chunk: int = 8192) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Running top-k of q @ pages.T.
-
-    q: [Bq, D] (pre-normalized for cosine); pages: [N, D]; returns
-    (scores [Bq, k], indices [Bq, k]) with indices into `pages` rows.
-    N is padded up to a chunk multiple internally; pad rows score -inf.
-    """
-    Bq, D = q.shape
-    N = pages.shape[0]
-    chunk = min(chunk, max(N, 1))
-    pad = (-N) % chunk
-    if pad:
-        pages = jnp.concatenate(
-            [pages, jnp.zeros((pad, D), pages.dtype)], axis=0)
+def _topk_scan(q: jnp.ndarray, pages: jnp.ndarray, k: int, chunk: int,
+               valid: jnp.ndarray, init=None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Running top-k of q @ pages.T. pages [N, D] with N % chunk == 0;
+    rows >= `valid` (traced scalar) are padding and score -inf. `init` lets
+    shard_map callers pass a carry pcast to the right varying axes."""
+    Bq = q.shape[0]
     n_chunks = pages.shape[0] // chunk
-    pages = pages.reshape(n_chunks, chunk, D)
-    valid = N  # rows >= valid are padding
+    blocks = pages.reshape(n_chunks, chunk, -1)
 
-    init_scores = jnp.full((Bq, k), -jnp.inf, jnp.float32)
-    init_idx = jnp.full((Bq, k), -1, jnp.int32)
+    if init is None:
+        init = (jnp.full((Bq, k), -jnp.inf, jnp.float32),
+                jnp.full((Bq, k), -1, jnp.int32))
+    init_scores, init_idx = init
 
     def body(carry, inp):
         best_s, best_i = carry
@@ -47,17 +50,161 @@ def chunked_topk(q: jnp.ndarray, pages: jnp.ndarray, k: int = 10,
         # cost of the fp32-via-bf16-passes matmul on TPU.
         s = jnp.matmul(q, block.T, precision=lax.Precision.HIGHEST,
                        preferred_element_type=jnp.float32)  # [Bq, chunk]
-        base = ci * chunk
-        ids = base + jnp.arange(chunk, dtype=jnp.int32)
+        ids = ci * chunk + jnp.arange(chunk, dtype=jnp.int32)
         s = jnp.where(ids[None, :] < valid, s, -jnp.inf)
         cat_s = jnp.concatenate([best_s, s], axis=1)
         cat_i = jnp.concatenate(
             [best_i, jnp.broadcast_to(ids[None], (Bq, chunk))], axis=1)
         top_s, pos = lax.top_k(cat_s, k)
         top_i = jnp.take_along_axis(cat_i, pos, axis=1)
+        # padding / -inf slots must not report a bogus row id
+        top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
         return (top_s, top_i), None
 
     (scores, idx), _ = lax.scan(
         body, (init_scores, init_idx),
-        (jnp.arange(n_chunks, dtype=jnp.int32), pages))
+        (jnp.arange(n_chunks, dtype=jnp.int32), blocks))
     return scores, idx
+
+
+@partial(jax.jit, static_argnames=("k", "chunk"))
+def chunked_topk(q: jnp.ndarray, pages: jnp.ndarray, k: int = 10,
+                 chunk: int = 8192) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-device running top-k of q @ pages.T.
+
+    q: [Bq, D] (pre-normalized for cosine); pages: [N, D]; returns
+    (scores [Bq, k], indices [Bq, k]) with indices into `pages` rows.
+    N is padded up to a chunk multiple internally; pad rows score -inf.
+    """
+    N, D = pages.shape
+    chunk = min(chunk, max(N, 1))
+    pad = (-N) % chunk
+    if pad:
+        pages = jnp.concatenate(
+            [pages, jnp.zeros((pad, D), pages.dtype)], axis=0)
+    return _topk_scan(q, pages, k, chunk, jnp.int32(N))
+
+
+_SHARDED_CACHE: Dict[Tuple, Tuple] = {}
+
+
+def _build_sharded_topk(mesh: Mesh, k: int, chunk: int):
+    """Jitted (q, pages, valid) -> (scores, global row idx) with pages
+    row-sharded over 'data'. Cached per (mesh, k, chunk)."""
+    n_data = mesh.shape["data"]
+
+    def run(q, pages_local, valid):
+        rows = pages_local.shape[0]                  # per-shard row count
+        shard = lax.axis_index("data")
+        valid_local = jnp.clip(valid - shard * rows, 0, rows).astype(jnp.int32)
+        c = min(chunk, rows)
+        pad = (-rows) % c
+        if pad:
+            pages_local = jnp.concatenate(
+                [pages_local,
+                 jnp.zeros((pad, pages_local.shape[1]), pages_local.dtype)])
+        # carry starts as a constant; pcast marks it varying over 'data' so
+        # the scan's in/out types agree under shard_map
+        init = jax.tree_util.tree_map(
+            lambda x: lax.pcast(x, ("data",), to="varying"),
+            (jnp.full((q.shape[0], k), -jnp.inf, jnp.float32),
+             jnp.full((q.shape[0], k), -1, jnp.int32)))
+        s, i = _topk_scan(q, pages_local, k, c, valid_local, init=init)
+        gi = jnp.where(i >= 0, i + shard * rows, -1)
+        # gather every shard's k candidates over ICI and merge everywhere
+        all_s = lax.all_gather(s, "data")            # [n_data, Bq, k]
+        all_i = lax.all_gather(gi, "data")
+        Bq = q.shape[0]
+        cat_s = jnp.transpose(all_s, (1, 0, 2)).reshape(Bq, n_data * k)
+        cat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(Bq, n_data * k)
+        kk = min(k, n_data * k)
+        top_s, pos = lax.top_k(cat_s, kk)
+        top_i = jnp.take_along_axis(cat_i, pos, axis=1)
+        top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
+        return top_s, top_i
+
+    # After the all_gather every shard computes the identical merge, so the
+    # P() outputs ARE replicated over 'data' — but that's a dynamic fact the
+    # static varying-axis checker can't infer; check_vma=False is the
+    # documented escape hatch for exactly this collective-then-merge shape.
+    mapped = shard_map(run, mesh=mesh,
+                       in_specs=(P(), P("data"), P()),
+                       out_specs=(P(), P()), check_vma=False)
+    return jax.jit(mapped)
+
+
+def sharded_topk(q: jnp.ndarray, pages, mesh: Mesh, k: int = 10,
+                 chunk: int = 8192, valid: int | None = None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k with pages [N, D] row-sharded over the mesh 'data' axis.
+
+    N must divide by mesh 'data'; rows >= `valid` are padding (score -inf,
+    index -1). q is replicated. Returns replicated (scores, indices) with
+    indices global into the sharded row order.
+    """
+    key = (mesh, int(k), int(chunk))
+    fn = _SHARDED_CACHE.get(key)
+    if fn is None:
+        fn = _SHARDED_CACHE[key] = _build_sharded_topk(mesh, k, chunk)
+    N = pages.shape[0]
+    if N % mesh.shape["data"]:
+        raise ValueError(f"pages rows {N} must divide mesh data axis "
+                         f"{mesh.shape['data']}; pad the input")
+    v = jnp.int32(N if valid is None else valid)
+    return fn(q, pages, v)
+
+
+def merge_topk_host(best_s: np.ndarray, best_i: np.ndarray,
+                    new_s: np.ndarray, new_i: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side running-top-k merge of two [Nq, k] candidate sets (ids are
+    global page ids; -1 = empty slot)."""
+    k = best_s.shape[1]
+    cat_s = np.concatenate([best_s, new_s], axis=1)
+    cat_i = np.concatenate([best_i, new_i], axis=1)
+    cat_s = np.where(cat_i < 0, -np.inf, cat_s)
+    pos = np.argsort(-cat_s, axis=1, kind="stable")[:, :k]
+    return (np.take_along_axis(cat_s, pos, axis=1),
+            np.take_along_axis(cat_i, pos, axis=1))
+
+
+def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
+                    chunk: int = 8192, query_batch: int = 1024
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stream the vector store through `sharded_topk`, one disk shard at a
+    time, merging a host-side running top-k. Returns (scores [Nq, k],
+    page_ids [Nq, k] int64, -1 padded). This is the cross-shard merge path
+    for 1B-page retrieval: peak HBM = one store shard / n_data per device,
+    peak host memory = one store shard + the query matrix.
+    """
+    nq, dim = query_vecs.shape
+    n_data = mesh.shape["data"]
+    best_s = np.full((nq, k), -np.inf, np.float32)
+    best_i = np.full((nq, k), -1, np.int64)
+    if store.num_vectors == 0 or nq == 0:
+        return best_s, best_i
+    # one static shape for every disk shard -> a single compiled program
+    shard_rows = max((s["count"] for s in store.manifest["shards"]),
+                     default=0)
+    shard_rows += (-shard_rows) % max(n_data, 1)
+    qb = min(query_batch, nq)
+    for ids, vecs in store.iter_shards():
+        n = vecs.shape[0]
+        buf = np.zeros((shard_rows, dim), np.float32)
+        buf[:n] = np.asarray(vecs, np.float32)
+        pages = jax.device_put(buf, NamedSharding(mesh, P("data")))
+        ids = np.asarray(ids, np.int64)
+        for s in range(0, nq, qb):
+            q = query_vecs[s: s + qb]
+            if q.shape[0] < qb:                      # pad to compiled shape
+                q = np.concatenate(
+                    [q, np.zeros((qb - q.shape[0], dim), q.dtype)])
+            sc, idx = sharded_topk(jnp.asarray(q, jnp.float32), pages, mesh,
+                                   k=k, chunk=chunk, valid=n)
+            sc = np.asarray(sc)[: min(qb, nq - s)]
+            idx = np.asarray(idx)[: min(qb, nq - s)]
+            pids = np.where(idx >= 0, ids[np.clip(idx, 0, n - 1)], -1)
+            best_s[s: s + qb], best_i[s: s + qb] = merge_topk_host(
+                best_s[s: s + qb], best_i[s: s + qb],
+                np.where(np.isfinite(sc), sc, -np.inf), pids)
+    return best_s, best_i

@@ -1,0 +1,84 @@
+"""Periodic re-mining pipeline: train -> embed -> mine -> continue-train
+(SURVEY.md §4.4; VERDICT r1 #5 — config 4's loop as ONE command instead of a
+manual CLI sequence).
+
+Each round trains `steps_per_round`, embeds the corpus with the CURRENT
+params into a fresh store generation, mines hard negatives with the CURRENT
+model, and feeds the refreshed table into the next round's batches — so
+negatives stay hard as the model improves (the point of periodic re-mining,
+BASELINE.json:10). Round boundaries checkpoint through the ordinary manager,
+so a killed pipeline resumes into the same schedule.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dnn_page_vectors_tpu.config import Config
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.mine.ann import HardNegatives, mine_hard_negatives
+from dnn_page_vectors_tpu.train.loop import Trainer
+from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+
+
+def run_pipeline(cfg: Config, rounds: int = 2,
+                 steps_per_round: Optional[int] = None,
+                 trainer: Optional[Trainer] = None,
+                 state=None, ckpt_manager=None,
+                 eval_every_round: bool = True) -> Dict[str, object]:
+    """Alternate train and re-mine for `rounds` rounds.
+
+    Returns {"state", "recalls": [per-round recall@k], "negatives"}.
+    `steps_per_round` defaults to cfg.train.steps // rounds.
+    """
+    if cfg.train.hard_negatives <= 0:
+        raise ValueError("pipeline needs train.hard_negatives > 0 "
+                         "(otherwise plain 'train' is the right command)")
+    steps_per_round = steps_per_round or max(1, cfg.train.steps // rounds)
+    trainer = trainer or Trainer(cfg)
+    state = state if state is not None else trainer.init_state()
+    log = MetricsLogger(trainer.workdir)
+    store_dir = os.path.join(trainer.workdir, "store")
+    negs_path = os.path.join(trainer.workdir, "hard_negatives.npy")
+
+    # resume: a restored state mid-pipeline re-enters the right round and
+    # picks up the last mined table
+    if os.path.exists(negs_path) and trainer.hard_negative_lookup is None:
+        trainer.hard_negative_lookup = HardNegatives.load(negs_path)
+
+    embedder: Optional[BulkEmbedder] = None
+    recalls: List[float] = []
+    negs = trainer.hard_negative_lookup
+    start_round = int(state.step) // steps_per_round
+    for r in range(start_round, rounds):
+        state, metrics = trainer.train(steps=steps_per_round, state=state,
+                                       log=log, ckpt_manager=ckpt_manager)
+        if embedder is None:
+            embedder = BulkEmbedder(cfg, trainer.model, state.params,
+                                    trainer.page_tok, trainer.mesh,
+                                    query_tok=trainer.query_tok)
+        else:
+            from dnn_page_vectors_tpu.parallel.sharding import shard_params
+            embedder.params = shard_params(state.params, trainer.mesh)
+        store = VectorStore(store_dir, dim=cfg.model.out_dim)
+        store.reset()                       # vectors from older params are stale
+        store.manifest["model_step"] = int(state.step)
+        store._flush_manifest()
+        embedder.embed_corpus(trainer.corpus, store, log=log)
+        if eval_every_round:
+            from dnn_page_vectors_tpu.evals.recall import evaluate_recall
+            recall, nq = evaluate_recall(embedder, trainer.corpus, store,
+                                         k=cfg.eval.recall_k)
+            recalls.append(recall)
+            log.write({"pipeline_round": r, "step": int(state.step),
+                       f"recall@{cfg.eval.recall_k}": recall})
+        if r + 1 < rounds:                  # last round's mine feeds nothing
+            negs = mine_hard_negatives(
+                embedder, trainer.corpus, store,
+                num_negatives=cfg.train.hard_negatives)
+            negs.save(negs_path)
+            trainer.hard_negative_lookup = negs
+    return {"state": state, "recalls": recalls, "negatives": negs}

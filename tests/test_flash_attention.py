@@ -71,3 +71,66 @@ def test_gradients_match_reference():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_non_multiple_lengths():
+    # exercises the backward's pad/slice path (L=37, S=53, blocks of 16)
+    q, k, v, mask = _mk(B=1, H=2, L=37, S=53, pad_tail=6)
+    g = jnp.asarray(np.random.default_rng(3).normal(size=(1, 2, 37, 16)),
+                    jnp.float32)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, mask, None, 16, 16) * g).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, mask) * g).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_with_t5_bias_fallback():
+    # bias path keeps the reference backward; grads incl. dbias must match
+    q, k, v, mask = _mk(B=1, H=2, L=32, S=32, pad_tail=4)
+    bias = jnp.asarray(np.random.default_rng(2).normal(size=(2, 32, 32)),
+                       jnp.float32)
+
+    def loss_flash(q, k, v, b):
+        return flash_attention(q, k, v, mask, b, 16, 16).sum()
+
+    def loss_ref(q, k, v, b):
+        return reference_attention(q, k, v, mask, b).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_backward_never_materializes_scores():
+    """VERDICT r1 #7 done-criterion: the compiled train-direction program
+    must contain no [B, H, L, S] tensor (the flash memory shape holds in
+    backward too). The reference path, by contrast, does."""
+    import re
+
+    B, H, L, S, Dh = 2, 2, 64, 64, 16
+    q, k, v, mask = _mk(B=B, H=H, L=L, S=S, Dh=Dh, pad_tail=4)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, mask, None, 16, 16).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, mask).sum()
+
+    score_shape = re.compile(rf"\[?{B},{H},{L},{S}\]?")
+    hlo_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2))).lower(
+        q, k, v).compile().as_text()
+    hlo_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2))).lower(
+        q, k, v).compile().as_text()
+    assert score_shape.search(hlo_ref), "oracle: reference must materialize"
+    assert not score_shape.search(hlo_flash), \
+        "flash backward materialized the [B,H,L,S] score tensor"

@@ -1,8 +1,11 @@
-"""Unit tests: chunked on-device top-k vs numpy reference."""
+"""Unit tests: chunked / sharded / store-streaming top-k vs numpy reference."""
 import jax.numpy as jnp
 import numpy as np
 
-from dnn_page_vectors_tpu.ops.topk import chunked_topk
+from dnn_page_vectors_tpu.ops.topk import (
+    chunked_topk, sharded_topk, topk_over_store)
+from dnn_page_vectors_tpu.parallel.mesh import make_mesh
+from dnn_page_vectors_tpu.config import MeshConfig
 
 
 def _np_topk(q, pages, k):
@@ -24,6 +27,55 @@ def test_chunked_topk_matches_numpy():
         assert np.asarray(i).shape == (5, 7)
         top1_scores = (q * pages[np.asarray(i)[:, 0]]).sum(-1)
         np.testing.assert_allclose(top1_scores, ns[:, 0], rtol=1e-4)
+
+
+def test_sharded_topk_matches_single_device(eight_devices):
+    """VERDICT r1 #2: pages sharded over 'data' must reproduce the
+    single-device ranking (cross-shard merge correctness)."""
+    mesh = make_mesh(MeshConfig(data=8))
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(6, 16)).astype(np.float32)
+    pages = rng.normal(size=(512, 16)).astype(np.float32)  # 64 rows/shard
+    s1, i1 = chunked_topk(jnp.asarray(q), jnp.asarray(pages), k=9)
+    s8, i8 = sharded_topk(jnp.asarray(q), jnp.asarray(pages), mesh, k=9,
+                          chunk=32)
+    np.testing.assert_allclose(np.asarray(s8), np.asarray(s1),
+                               rtol=1e-4, atol=1e-5)
+    # `valid` must mask the tail rows exactly like truncating the input
+    sv, iv = sharded_topk(jnp.asarray(q), jnp.asarray(pages), mesh, k=9,
+                          chunk=32, valid=200)
+    st, _ = chunked_topk(jnp.asarray(q), jnp.asarray(pages[:200]), k=9)
+    np.testing.assert_allclose(np.asarray(sv), np.asarray(st),
+                               rtol=1e-4, atol=1e-5)
+    assert (np.asarray(iv) < 200).all()
+
+
+def test_topk_over_store_matches_brute_force(eight_devices, tmp_path):
+    """Streaming the store shard-by-shard over the mesh must equal one giant
+    in-memory search — no step materializes the full store."""
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+
+    mesh = make_mesh(MeshConfig(data=8))
+    rng = np.random.default_rng(2)
+    dim, n = 16, 700                       # 3 shards: 256, 256, 188
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    ids = np.arange(1000, 1000 + n)        # page ids != row numbers
+    store = VectorStore(str(tmp_path / "store"), dim=dim, shard_size=256)
+    for si in range(3):
+        sl = slice(si * 256, min((si + 1) * 256, n))
+        store.write_shard(si, ids[sl], vecs[sl])
+    q = rng.normal(size=(33, dim)).astype(np.float32)
+    scores, pids = topk_over_store(q, store, mesh, k=10, chunk=64,
+                                   query_batch=8)
+    # the store rounds vectors to fp16; the oracle must score what it stores
+    ref_s = q @ vecs.astype(np.float16).astype(np.float32).T
+    ref_idx = np.argsort(-ref_s, axis=1)[:, :10]
+    np.testing.assert_allclose(
+        scores, np.take_along_axis(ref_s, ref_idx, axis=1),
+        rtol=1e-4, atol=1e-4)
+    # ids must be the store's page ids, not row numbers
+    assert set(np.unique(pids)) <= set(ids.tolist())
 
 
 def test_chunked_topk_small_corpus():

@@ -1,0 +1,98 @@
+"""Config/tokenizer vocab agreement (VERDICT r1 #3).
+
+The named configs claim real vocab geometries (30,522 WordPiece for
+BERT-mini, 100k words for Kim-CNN, 250,112 SentencePiece for mT5). Round 1
+silently clamped training to 8,192 pieces / 20k pages, so configs 3-5 did
+not train what they claimed. These tests pin the new contract:
+`build_tokenizer` returns EXACTLY config.data.vocab_size ids or raises —
+for every named config — and a cached vocab is never reused across a
+config/corpus change (ADVICE r1: stale tokenizer cache).
+
+Corpora are shrunk via num_pages (generation cost), never via vocab.
+"""
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.data.loader import build_corpus, build_tokenizer
+from dnn_page_vectors_tpu.data.subword import SubwordTokenizer
+from dnn_page_vectors_tpu.data.words import WordTokenizer
+
+
+def _built_vocab(name, overrides):
+    cfg = get_config(name, overrides)
+    corpus = build_corpus(cfg)
+    q_tok, p_tok = build_tokenizer(cfg, corpus)
+    return cfg, q_tok, p_tok
+
+
+def test_config1_cdssm_trigram_buckets():
+    cfg, q, p = _built_vocab("cdssm_toy", {"data.num_pages": 1_000})
+    assert p.vocab_size == cfg.data.trigram_buckets + 1  # +1: pad row 0
+
+
+def test_config2_kim_cnn_true_100k_word_vocab():
+    cfg, q, p = _built_vocab("kim_cnn_v5e8", {"data.num_pages": 200_000})
+    assert p.vocab_size == cfg.data.vocab_size == 100_000
+
+
+def test_config3_bert_true_30522_vocab():
+    cfg, q, p = _built_vocab("bert_mini_v5p16", {"data.num_pages": 100_000})
+    assert p.vocab_size == cfg.data.vocab_size == 30_522
+    # query tower shares the page vocab (two-tower invariant)
+    assert q.vocab == p.vocab
+
+
+def test_config4_hardneg_same_claim_as_config3():
+    # config 4 shares config 3's tokenizer family and vocab claim; the
+    # builder path is identical, so assert the claim equality instead of
+    # re-training another 30,522-piece vocab
+    c3 = get_config("bert_mini_v5p16")
+    c4 = get_config("hardneg_v5p64")
+    assert c4.data.tokenizer == c3.data.tokenizer
+    assert c4.data.vocab_size == c3.data.vocab_size
+
+
+def test_config5_mt5_true_250112_vocab():
+    cfg, q, p = _built_vocab("mt5_multilingual",
+                             {"data.num_pages": 300_000})
+    assert p.vocab_size == cfg.data.vocab_size == 250_112
+    assert p.style == "sentencepiece"
+
+
+def test_unreachable_vocab_raises():
+    cfg = get_config("bert_mini_v5p16", {"data.num_pages": 50})
+    corpus = build_corpus(cfg)
+    with pytest.raises(ValueError, match="vocab_size"):
+        build_tokenizer(cfg, corpus)
+
+
+def test_word_vocab_unreachable_raises():
+    with pytest.raises(ValueError, match="unique words"):
+        WordTokenizer.train(["a b c"], vocab_size=100, strict_vocab=True)
+
+
+def test_stale_cache_invalidated(tmp_path):
+    """Changing data.vocab_size (or the corpus) must rebuild, not silently
+    reuse, the cached vocab (ADVICE r1 loader.py:52)."""
+    over = {"data.num_pages": 2_000, "data.vocab_size": 512}
+    cfg = get_config("bert_mini_v5p16", over)
+    corpus = build_corpus(cfg)
+    _, p1 = build_tokenizer(cfg, corpus, cache_dir=str(tmp_path))
+    assert p1.vocab_size == 512
+    # same cache dir, new vocab size -> must NOT reuse the 512 vocab
+    cfg2 = get_config("bert_mini_v5p16",
+                      {"data.num_pages": 2_000, "data.vocab_size": 640})
+    _, p2 = build_tokenizer(cfg2, build_corpus(cfg2),
+                            cache_dir=str(tmp_path))
+    assert p2.vocab_size == 640
+    # unchanged config -> reuses the cache (vector-store reproducibility)
+    _, p3 = build_tokenizer(cfg2, build_corpus(cfg2),
+                            cache_dir=str(tmp_path))
+    assert p3.vocab == p2.vocab
+
+
+def test_fast_bpe_deterministic_at_scale():
+    texts = [f"alpha{i % 97} beta{i % 31} gamma{i % 13}" for i in range(3_000)]
+    v1 = SubwordTokenizer.train(texts, vocab_size=160).vocab
+    v2 = SubwordTokenizer.train(texts, vocab_size=160).vocab
+    assert v1 == v2 and len(v1) == 158  # + 2 reserved ids = 160

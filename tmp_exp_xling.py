@@ -1,0 +1,43 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import numpy as np, tempfile, time
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.evals.recall import evaluate_recall
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.train.loop import Trainer
+
+t0 = time.time()
+cfg = get_config("mt5_multilingual", {
+    "data.num_pages": 600,
+    "data.languages": 3,
+    "data.vocab_size": 1024,
+    "data.page_len": 48,
+    "data.query_len": 12,
+    "model.num_layers": 2,
+    "model.num_heads": 4,
+    "model.model_dim": 96,
+    "model.mlp_dim": 192,
+    "model.out_dim": 64,
+    "model.dropout": 0.0,
+    "mesh.data": 1, "mesh.model": 1,
+    "train.batch_size": 64,
+    "train.steps": 300,
+    "train.warmup_steps": 20,
+    "train.learning_rate": 2e-3,
+    "train.log_every": 100,
+    "eval.eval_queries": 200,
+    "eval.embed_batch_size": 128,
+})
+wd = tempfile.mkdtemp()
+trainer = Trainer(cfg, workdir=wd)
+print("tok vocab", trainer.page_tok.vocab_size, "setup", round(time.time()-t0,1))
+state, metrics = trainer.train()
+print("train done", round(time.time()-t0,1), {k: round(float(v),3) for k,v in metrics.items()})
+store = VectorStore(os.path.join(wd, "store"), dim=cfg.model.out_dim, shard_size=256)
+embedder = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                        trainer.mesh, query_tok=trainer.query_tok)
+embedder.embed_corpus(trainer.corpus, store, batch_size=128)
+recall, nq = evaluate_recall(embedder, trainer.corpus, store, num_queries=200, k=10)
+print("XLING recall@10", recall, "nq", nq, "total", round(time.time()-t0,1))
