@@ -63,8 +63,19 @@ def _previous_bench() -> float | None:
 # Worker: the actual measurement (runs in a subprocess).
 # ---------------------------------------------------------------------------
 
+def _stamp(msg: str) -> None:
+    # Progress stamps on stderr: if an attempt times out, the wrapper's
+    # captured stderr tail says exactly which stage hung (round-2 timeouts
+    # were undiagnosable without this).
+    print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
 def run_worker() -> None:
-    from dnn_page_vectors_tpu.utils.platform import honor_jax_platforms_env
+    from dnn_page_vectors_tpu.utils.platform import hard_sync, honor_jax_platforms_env
     honor_jax_platforms_env()
     import jax
 
@@ -73,9 +84,11 @@ def run_worker() -> None:
     from dnn_page_vectors_tpu.utils.flops import (
         device_peak_flops, embed_flops_per_page, train_flops_per_pair)
 
+    _stamp("initializing backend")
     devs = jax.devices()
     n_dev = len(devs)
     peak = device_peak_flops(devs[0])
+    _stamp(f"backend up: {n_dev}x {getattr(devs[0], 'device_kind', '?')}")
 
     # Scale knobs: defaults sized for one real TPU chip; the CPU smoke path
     # (tests, debugging) shrinks via env.
@@ -83,38 +96,50 @@ def run_worker() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "40"))
     embed_iters = int(os.environ.get("BENCH_EMBED_ITERS", "60"))
     batch = per_chip * n_dev
+    # vocab_size 8_192, not config 3's 30_522: the honesty contract
+    # (loader.py:52) raises when the corpus cannot supply the configured
+    # vocab, and the bench's toy corpus tops out near 13.6k mergeable ids —
+    # this exact mismatch killed BENCH_r02. Vocab size only changes the
+    # embedding-table gather, not the MXU matmul FLOPs that dominate the
+    # step, so the measured pages/sec/chip is representative of config 3.
     cfg = get_config("bert_mini_v5p16", {
         "data.num_pages": max(2_048, batch),
         "data.query_len": 16,
         "data.page_len": 64,
+        "data.vocab_size": 8_192,
         "train.batch_size": batch,
         "train.steps": steps,
         "train.log_every": 1_000_000,  # keep logging off the timed path
         "mesh.data": n_dev,
     })
     trainer = Trainer(cfg, workdir="/tmp/dnn_page_vectors_tpu_bench")
+    _stamp("trainer built (tokenizer trained)")
     state = trainer.init_state()
     step_fn = trainer.compiled_step(state)
+    _stamp("state initialized")
 
     from dnn_page_vectors_tpu.parallel.sharding import replicated
     it = iter(trainer.batches())
     batches = [next(it) for _ in range(4)]
     base_rng = jax.device_put(jax.random.PRNGKey(0), replicated(trainer.mesh))
+    _stamp("batches staged; compiling train step")
 
     for i in range(5):  # warmup + compile
         state, metrics = step_fn(state, batches[i % len(batches)], base_rng)
-    jax.block_until_ready(state.params)
+    hard_sync(metrics)  # NOT block_until_ready: see utils/platform.hard_sync
+    _stamp("train step compiled+warm; timing")
 
     timed_steps = cfg.train.steps
     t0 = time.perf_counter()
     for i in range(timed_steps):
         state, metrics = step_fn(state, batches[i % len(batches)], base_rng)
-    jax.block_until_ready(state.params)
+    hard_sync(metrics)
     dt = time.perf_counter() - t0
 
     train_pps_chip = batch * timed_steps / dt / n_dev
     train_flops = train_flops_per_pair(cfg, batch)
     train_mfu = (train_pps_chip * train_flops / peak) if peak else None
+    _stamp(f"train timed: {train_pps_chip:.1f} pages/s/chip; compiling embed")
 
     # ---- bulk-embed sweep (forward-only encode_page, device-resident) ----
     from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
@@ -123,11 +148,11 @@ def run_worker() -> None:
                             query_tok=trainer.query_tok)
     page_batch = batches[0]["page"]
     out = embedder._encode_page(embedder.params, page_batch)
-    jax.block_until_ready(out)
+    hard_sync(out)
     t0 = time.perf_counter()
     for _ in range(embed_iters):
         out = embedder._encode_page(embedder.params, page_batch)
-    jax.block_until_ready(out)
+    hard_sync(out)
     dt_e = time.perf_counter() - t0
     embed_pps_chip = batch * embed_iters / dt_e / n_dev
     embed_flops = embed_flops_per_page(cfg)
@@ -191,8 +216,14 @@ def main() -> None:
                 return
             tail = (proc.stderr or proc.stdout or "").strip().splitlines()
             last_err = " | ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
-        except subprocess.TimeoutExpired:
-            last_err = f"worker attempt {attempt} timed out after {ATTEMPT_TIMEOUT}s"
+        except subprocess.TimeoutExpired as e:
+            # surface the worker's progress stamps so the hung stage is named
+            err = e.stderr or b""
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            tail = " | ".join(err.strip().splitlines()[-3:])
+            last_err = (f"worker attempt {attempt} timed out after "
+                        f"{ATTEMPT_TIMEOUT}s; stderr tail: {tail}")
         if time.time() + delay >= deadline:
             break
         time.sleep(delay)

@@ -23,3 +23,20 @@ def honor_jax_platforms_env() -> None:
             jax.config.update("jax_platforms", plat)
     except Exception:
         pass
+
+
+def hard_sync(tree) -> None:
+    """Barrier that provably waits for device execution to finish.
+
+    On the tunneled 'axon' TPU backend, ``jax.block_until_ready`` returns
+    after dispatch, not execution — measured >2000 TFLOP/s "throughput" on a
+    197 TFLOP/s chip when timing with it (round-3 diagnosis of the impossible
+    MFU>1 in BENCH_r02-era timings). Pulling one element of the result back
+    to the host cannot complete until the producing computation has, so every
+    timing path must use this instead of block_until_ready.
+    """
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        np.asarray(jax.device_get(leaf.ravel()[0:1]))
