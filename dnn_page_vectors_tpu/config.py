@@ -28,6 +28,9 @@ class DataConfig:
     trigram_buckets: int = 16_384    # hash-bucket vocab for char trigrams
     vocab_size: int = 30_000         # word / subword vocab size
     languages: int = 1               # >1: cross-lingual toy corpus (config 5)
+    num_topics: int = 64             # toy-corpus topics; fewer => more
+                                     # near-duplicate pages per topic, harder
+                                     # within-topic retrieval (mining tests)
     seed: int = 0
 
 

@@ -32,7 +32,7 @@ def build_corpus(cfg: Config):
     if d.corpus == "toy":
         return ToyCorpus(num_pages=d.num_pages, seed=d.seed,
                          page_len=d.page_len, query_len=d.query_len,
-                         languages=d.languages)
+                         languages=d.languages, num_topics=d.num_topics)
     if d.corpus.startswith("jsonl:"):
         return JsonlCorpus(d.corpus[len("jsonl:"):])
     raise ValueError(f"unknown corpus {d.corpus!r} (want 'toy' or 'jsonl:<path>')")

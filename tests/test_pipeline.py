@@ -1,0 +1,125 @@
+"""Periodic re-mining pipeline tests (SURVEY.md §4.4; VERDICT r1 #5).
+
+Two claims:
+
+1. `run_pipeline` alternates train -> embed -> mine -> continue-train as one
+   command, recall improves across rounds, and the mined table is sane.
+
+2. Mined hard negatives beat in-batch-only training: from the same
+   partially-trained snapshot, the same number of further steps reaches
+   higher Recall@10 with mined negatives in the loss than without.
+
+Regime notes (calibrated by round-3 experiments): the branch point must be a
+partially-trained model — mining from a near-random model returns arbitrary
+same-topic near-duplicates (false negatives) and measurably HURTS training,
+while a saturated model leaves no headroom (this toy task reaches recall 1.0
+from in-batch negatives alone given enough steps). Everything here is
+deterministic (fixed seeds, CPU backend), so the comparison is exact, not
+statistical.
+"""
+import os
+
+import jax
+import numpy as np
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.evals.recall import evaluate_recall
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives
+from dnn_page_vectors_tpu.train.loop import Trainer
+from dnn_page_vectors_tpu.train.pipeline import run_pipeline
+
+
+def _eval(cfg, trainer, state, wd, tag):
+    store = VectorStore(os.path.join(wd, "store_" + tag),
+                        dim=cfg.model.out_dim, shard_size=256)
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+    emb.embed_corpus(trainer.corpus, store, batch_size=128)
+    r, _ = evaluate_recall(emb, trainer.corpus, store, num_queries=400, k=10)
+    return r, emb, store
+
+
+def test_hard_negatives_beat_in_batch_only(tmp_path):
+    # Hard regime: 40 near-duplicate pages per topic and queries that are
+    # mostly topic words, so within-topic discrimination is the whole task
+    # and random recall@10 is 10/1200 ~ 0.8%.
+    warm, extra = 75, 12
+    cfg = get_config("cdssm_toy", {
+        "data.num_pages": 1200,
+        "data.num_topics": 30,
+        "data.query_len": 24,
+        "data.trigram_buckets": 4096,
+        "model.embed_dim": 48,
+        "model.conv_channels": 96,
+        "model.out_dim": 48,
+        "train.batch_size": 64,
+        "train.steps": warm + extra,
+        "train.warmup_steps": 10,
+        "train.learning_rate": 2e-3,
+        "train.log_every": 1000,
+        "train.hard_negatives": 7,
+        "eval.eval_queries": 400,
+        "eval.embed_batch_size": 128,
+    })
+    wd = str(tmp_path)
+    trainer = Trainer(cfg, workdir=wd)
+    state, _ = trainer.train(steps=warm)
+    snap = jax.device_get(state)        # host copy survives donation
+    r_warm, emb, store = _eval(cfg, trainer, state, wd, "warm")
+    negs = mine_hard_negatives(emb, trainer.corpus, store, num_negatives=7)
+
+    # table sanity: right shape, in-range, never the gold page
+    assert negs.table.shape == (1200, 7)
+    assert negs.table.min() >= 0 and negs.table.max() < 1200
+    assert not (negs.table == np.arange(1200)[:, None]).any()
+
+    trainer.hard_negative_lookup = None
+    s_a, _ = trainer.train(steps=extra, state=jax.device_put(snap))
+    r_in_batch, _, _ = _eval(cfg, trainer, s_a, wd, "in_batch")
+
+    trainer.hard_negative_lookup = negs
+    s_b, _ = trainer.train(steps=extra, state=jax.device_put(snap))
+    r_mined, _, _ = _eval(cfg, trainer, s_b, wd, "mined")
+
+    assert r_warm > 0.1, f"warmup failed to train at all: {r_warm}"
+    assert r_mined > r_warm, (r_warm, r_mined)
+    assert r_mined > r_in_batch, (
+        f"mined negatives ({r_mined}) should beat in-batch-only "
+        f"({r_in_batch}) from the same snapshot + step budget")
+
+
+def test_run_pipeline_end_to_end(tmp_path):
+    # Easy regime so two short rounds converge: the point here is the
+    # orchestration (round alternation, store regeneration, table refresh),
+    # not the mining-benefit claim above.
+    cfg = get_config("cdssm_toy", {
+        "data.num_pages": 600,
+        "data.trigram_buckets": 4096,
+        "model.embed_dim": 48,
+        "model.conv_channels": 96,
+        "model.out_dim": 48,
+        "train.batch_size": 64,
+        "train.steps": 120,
+        "train.warmup_steps": 10,
+        "train.learning_rate": 2e-3,
+        "train.log_every": 1000,
+        "train.hard_negatives": 7,
+        "eval.eval_queries": 300,
+        "eval.embed_batch_size": 128,
+    })
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    out = run_pipeline(cfg, rounds=2, trainer=trainer)
+    recalls = out["recalls"]
+    assert len(recalls) == 2
+    assert recalls[1] >= recalls[0], recalls
+    assert recalls[1] > 0.5, recalls     # random ~ 1.7%
+    # the mined table was refreshed and persisted for resume
+    assert out["negatives"] is not None
+    assert os.path.exists(os.path.join(trainer.workdir, "hard_negatives.npy"))
+    # store holds the FINAL round's vectors (regenerated, not stale)
+    store = VectorStore(os.path.join(trainer.workdir, "store"),
+                        dim=cfg.model.out_dim)
+    assert store.num_vectors == 600
+    assert store.manifest["model_step"] == 120
