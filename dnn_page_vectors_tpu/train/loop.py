@@ -30,7 +30,8 @@ from dnn_page_vectors_tpu.models.factory import build_two_tower
 from dnn_page_vectors_tpu.models.losses import cosine_contrastive_loss
 from dnn_page_vectors_tpu.parallel.mesh import fit_mesh_to_devices, make_mesh
 from dnn_page_vectors_tpu.parallel.sharding import (
-    batch_sharding, param_shardings, replicated, shard_params)
+    batch_sharding, param_shardings, replicated, shard_params,
+    stacked_batch_sharding)
 from dnn_page_vectors_tpu.train.optimizer import make_optimizer
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
 
@@ -97,6 +98,7 @@ class Trainer:
         self.tx = make_optimizer(cfg.train)
         self.hard_negative_lookup = hard_negative_lookup
         self._compiled = None
+        self._compiled_multi = None
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:
@@ -141,14 +143,58 @@ class Trainer:
             )
         return self._compiled
 
-    def batches(self, start_step: int = 0) -> Iterator[Any]:
-        batcher = TrainBatcher(
+    def _make_batcher(self, start_step: int) -> TrainBatcher:
+        return TrainBatcher(
             self.corpus, self.query_tok, self.page_tok,
             batch_size=self.cfg.train.batch_size, seed=self.cfg.train.seed,
             start_step=start_step,
             hard_negative_lookup=self.hard_negative_lookup)
-        return prefetch_to_device(iter(batcher),
+
+    def batches(self, start_step: int = 0) -> Iterator[Any]:
+        return prefetch_to_device(iter(self._make_batcher(start_step)),
                                   sharding=batch_sharding(self.mesh))
+
+    def stacked_batches(self, start_step: int = 0, k: int = 1) -> Iterator[Any]:
+        """[K, B, ...] stacks of K consecutive batches for the scan_steps
+        fused dispatch; same data order as batches()."""
+        batcher = self._make_batcher(start_step)
+
+        def _stack(it):
+            while True:
+                group = [b for _, b in zip(range(k), it)]
+                if len(group) < k:
+                    return
+                yield {key: np.stack([g[key] for g in group])
+                       for key in group[0]}
+
+        return prefetch_to_device(_stack(iter(batcher)),
+                                  sharding=stacked_batch_sharding(self.mesh))
+
+    def compiled_multi_step(self, state: TrainState):
+        """Train-K-steps-in-one-dispatch: lax.scan over a [K, ...] batch
+        stack, donated carry; K is the stack's leading dim (jit retraces per
+        K, so one cached wrapper serves any stack size). Semantically
+        identical to K calls of the single step (same rng folding: the step
+        counter advances inside the scan); metrics returned are the LAST
+        step's, matching what a per-step loop would log at the boundary."""
+        if self._compiled_multi is None:
+            step_fn = make_train_step(self.model, self.tx)
+
+            def multi(state, stacked, base_rng):
+                def body(st, batch):
+                    return step_fn(st, batch, base_rng)
+                state, ms = jax.lax.scan(body, state, stacked)
+                return state, jax.tree_util.tree_map(lambda x: x[-1], ms)
+
+            state_sh = jax.tree_util.tree_map(lambda x: x.sharding, state)
+            self._compiled_multi = jax.jit(
+                multi,
+                in_shardings=(state_sh, stacked_batch_sharding(self.mesh),
+                              replicated(self.mesh)),
+                out_shardings=(state_sh, replicated(self.mesh)),
+                donate_argnums=(0,),
+            )
+        return self._compiled_multi
 
     # -- driver -----------------------------------------------------------
     def train(self, steps: Optional[int] = None,
@@ -162,20 +208,39 @@ class Trainer:
         cfg = self.cfg
         steps = cfg.train.steps if steps is None else steps
         state = self.init_state() if state is None else state
-        step_fn = self.compiled_step(state)
+        scan_k = max(1, cfg.train.scan_steps)
+        if scan_k > 1:
+            checks = [("log_every", cfg.train.log_every)]
+            if ckpt_manager is not None:   # only enforced when it can fire
+                checks.append(("checkpoint_every", cfg.train.checkpoint_every))
+            for name, every in checks:
+                if every % scan_k:
+                    raise ValueError(
+                        f"train.{name}={every} must be a multiple of "
+                        f"train.scan_steps={scan_k}: host-side events can "
+                        "only fire at fused-dispatch boundaries")
+            if steps % scan_k:
+                raise ValueError(
+                    f"steps={steps} must be a multiple of "
+                    f"train.scan_steps={scan_k}")
+            step_fn = self.compiled_multi_step(state)
+        else:
+            step_fn = self.compiled_step(state)
         base_rng = jax.device_put(jax.random.PRNGKey(cfg.train.seed + 1),
                                   replicated(self.mesh))
         log = log or MetricsLogger(self.workdir)
         pages_per_step = cfg.train.batch_size
         n_dev = self.mesh.devices.size
         start_step = int(state.step)
-        it = self.batches(start_step=start_step)
+        it = (self.stacked_batches(start_step=start_step, k=scan_k)
+              if scan_k > 1 else self.batches(start_step=start_step))
         last: Dict[str, float] = {}
         t0 = time.perf_counter()
-        for i in range(steps):
+        for c in range(steps // scan_k):
             batch = next(it)
             state, metrics = step_fn(state, batch, base_rng)
-            if (i + 1) % cfg.train.log_every == 0 or i + 1 == steps:
+            i = (c + 1) * scan_k         # steps completed this call
+            if i % cfg.train.log_every == 0 or i == steps:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 jax.block_until_ready(state.params)
                 dt = time.perf_counter() - t0
@@ -186,7 +251,7 @@ class Trainer:
                 log.write(metrics)
                 last = metrics
             if (ckpt_manager is not None
-                    and (i + 1) % cfg.train.checkpoint_every == 0
-                    and i + 1 < steps):  # final save is the caller's
+                    and i % cfg.train.checkpoint_every == 0
+                    and i < steps):      # final save is the caller's
                 ckpt_manager.save(int(state.step), state)
         return state, last

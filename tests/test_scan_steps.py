@@ -1,0 +1,53 @@
+"""train.scan_steps fuses K optimizer steps into one lax.scan dispatch
+(config.py TrainConfig.scan_steps). The contract: numerically equivalent
+training to the per-step path — same rng folding (the step counter advances
+inside the scan), same data order, same donation semantics. Equivalence is
+up to float reassociation: GSPMD schedules the sharded-batch collectives of
+the scanned program differently, so per-step drift of ~1e-5 is expected on
+the 8-device mesh (observed 1.2e-5 after 12 steps), not a bug.
+"""
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.train.loop import Trainer
+
+_OV = {
+    "data.num_pages": 512,
+    "data.trigram_buckets": 2048,
+    "model.embed_dim": 32,
+    "model.conv_channels": 64,
+    "model.out_dim": 32,
+    "train.batch_size": 64,
+    "train.steps": 12,
+    "train.warmup_steps": 2,
+    "train.log_every": 12,
+    "train.learning_rate": 2e-3,
+}
+
+
+def test_scan_steps_matches_per_step(tmp_path):
+    t1 = Trainer(get_config("cdssm_toy", _OV), workdir=str(tmp_path / "a"))
+    s1, m1 = t1.train()
+
+    t2 = Trainer(get_config("cdssm_toy", dict(_OV, **{"train.scan_steps": 4})),
+                 workdir=str(tmp_path / "b"))
+    s2, m2 = t2.train()
+
+    assert int(s1.step) == int(s2.step) == 12
+    assert abs(m1["loss"] - m2["loss"]) < 1e-4, (m1["loss"], m2["loss"])
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        s1.params, s2.params)
+    assert max(jax.tree_util.tree_leaves(diffs)) < 5e-4
+
+
+def test_scan_steps_rejects_misaligned_boundaries(tmp_path):
+    cfg = get_config("cdssm_toy", dict(_OV, **{
+        "train.scan_steps": 5}))        # 12 % 5 != 0
+    t = Trainer(cfg, workdir=str(tmp_path))
+    with pytest.raises(ValueError, match="multiple of"):
+        t.train()
