@@ -125,7 +125,6 @@ def run_worker() -> None:
     trainer = Trainer(cfg, workdir="/tmp/dnn_page_vectors_tpu_bench")
     _stamp("trainer built (tokenizer trained)")
     state = trainer.init_state()
-    step_fn = trainer.compiled_step(state)
     _stamp("state initialized")
 
     from dnn_page_vectors_tpu.parallel.sharding import replicated
@@ -133,6 +132,7 @@ def run_worker() -> None:
         step_fn = trainer.compiled_multi_step(state)
         it = iter(trainer.stacked_batches(k=scan_k))
     else:
+        step_fn = trainer.compiled_step(state)
         it = iter(trainer.batches())
     batches = [next(it) for _ in range(2 if scan_k > 1 else 4)]
     base_rng = jax.device_put(jax.random.PRNGKey(0), replicated(trainer.mesh))

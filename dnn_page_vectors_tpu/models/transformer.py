@@ -56,8 +56,10 @@ class RmsNorm(nn.Module):
 class Attention(nn.Module):
     """kind: 'dense' (materialised scores), 'flash' (Pallas kernel,
     ops/flash_attention.py), or 'ring' (sequence-parallel over the mesh
-    'seq' axis, parallel/ring_attention.py — bert variant only; T5 relative
-    bias is not supported across the ring)."""
+    'seq' axis, parallel/ring_attention.py). For the T5 variant, dense/flash
+    take the materialised rel_bias while ring takes rel_bias_table — the
+    ring rebuilds its bias block per step from global positions instead of
+    ever holding the O(L²) bias."""
     num_heads: int
     model_dim: int
     use_bias: bool
@@ -67,7 +69,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, pad_mask: jnp.ndarray,
-                 rel_bias: jnp.ndarray | None) -> jnp.ndarray:
+                 rel_bias: jnp.ndarray | None,
+                 rel_bias_table: jnp.ndarray | None = None) -> jnp.ndarray:
         head_dim = self.model_dim // self.num_heads
         dense = lambda name: nn.Dense(self.model_dim, use_bias=self.use_bias,
                                       dtype=self.dtype, name=name)
@@ -84,10 +87,14 @@ class Attention(nn.Module):
             out = bhld(out.astype(self.dtype))                # [B, L, H, Dh]
         elif self.kind == "ring":
             from dnn_page_vectors_tpu.parallel.ring_attention import ring_attention
-            assert rel_bias is None, "ring attention: bert variant only"
             assert self.mesh is not None, "ring attention needs a mesh"
+            # ring consumes the bias TABLE (rebuilt per step); a materialised
+            # [1,H,L,L] bias here means a caller wired the wrong operand
+            assert rel_bias is None, "ring attention takes rel_bias_table"
             out = ring_attention(self.mesh, bhld(q), bhld(k), bhld(v),
-                                 pad_mask)
+                                 pad_mask, bias_table=rel_bias_table,
+                                 bucket_fn=(None if rel_bias_table is None
+                                            else _relative_position_bucket))
             out = bhld(out.astype(self.dtype))
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
@@ -113,7 +120,8 @@ class Block(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, pad_mask, rel_bias, deterministic: bool = True):
+    def __call__(self, x, pad_mask, rel_bias, rel_bias_table=None,
+                 deterministic: bool = True):
         norm = (lambda n: RmsNorm(dtype=self.dtype, name=n)) if self.variant == "t5" \
             else (lambda n: nn.LayerNorm(dtype=self.dtype, name=n))
         use_bias = self.variant != "t5"
@@ -121,7 +129,8 @@ class Block(nn.Module):
         h = norm("ln_attn")(x)
         h = Attention(self.num_heads, self.model_dim, use_bias,
                       dtype=self.dtype, kind=self.attention_kind,
-                      mesh=self.mesh, name="attn")(h, pad_mask, rel_bias)
+                      mesh=self.mesh, name="attn")(h, pad_mask, rel_bias,
+                                                   rel_bias_table)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         x = x + h
 
@@ -164,24 +173,31 @@ class TransformerEncoder(nn.Module):
         x = nn.Embed(self.vocab_size, self.model_dim, dtype=self.dtype,
                      name="tok_embed")(ids)
         rel_bias = None
+        rel_bias_table = None
         if self.variant == "bert":
             pos = self.param("pos_embed", nn.initializers.normal(0.02),
                              (self.max_len, self.model_dim))
             x = x + pos[:L].astype(self.dtype)[None]
         else:
             # shared-across-layers relative position bias (T5 style)
-            pos = jnp.arange(L)
-            buckets = _relative_position_bucket(pos[None, :] - pos[:, None])
             table = self.param("rel_bias", nn.initializers.normal(0.02),
                                (32, self.num_heads))
-            rel_bias = table[buckets].transpose(2, 0, 1)[None]     # [1, H, L, L]
-            rel_bias = rel_bias.astype(jnp.float32)
+            if self.attention_kind == "ring":
+                # never materialise [L, L] here: the ring rebuilds its bias
+                # block per step from global positions (ring_attention.py)
+                rel_bias_table = table
+            else:
+                pos = jnp.arange(L)
+                buckets = _relative_position_bucket(pos[None, :] - pos[:, None])
+                rel_bias = table[buckets].transpose(2, 0, 1)[None]  # [1,H,L,L]
+                rel_bias = rel_bias.astype(jnp.float32)
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.model_dim, self.mlp_dim,
                       self.variant, self.dropout, dtype=self.dtype,
                       attention_kind=self.attention_kind, mesh=self.mesh,
-                      name=f"block{i}")(x, pad_mask, rel_bias, deterministic)
+                      name=f"block{i}")(x, pad_mask, rel_bias, rel_bias_table,
+                                        deterministic)
         x = (RmsNorm(dtype=self.dtype, name="ln_final") if self.variant == "t5"
              else nn.LayerNorm(dtype=self.dtype, name="ln_final"))(x)
         # masked mean pool

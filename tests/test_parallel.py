@@ -32,8 +32,9 @@ def _tiny_cfg(mesh_data=1, mesh_model=1, encoder="cdssm"):
         "mesh.data": mesh_data,
         "mesh.model": mesh_model,
     }
-    name = {"cdssm": "cdssm_toy", "bert": "bert_mini_v5p16"}[encoder]
-    if encoder == "bert":
+    name = {"cdssm": "cdssm_toy", "bert": "bert_mini_v5p16",
+            "t5": "mt5_multilingual"}[encoder]
+    if encoder in ("bert", "t5"):
         overrides.update({"model.num_layers": 2, "model.model_dim": 32,
                           "model.num_heads": 4, "model.mlp_dim": 64,
                           "model.dropout": 0.0})
@@ -113,14 +114,16 @@ def test_sharded_bulk_embed_equals_single_device(tmp_path, eight_devices):
                                rtol=2e-3, atol=2e-3)
 
 
-def test_ring_sp_training_equals_dense(tmp_path, eight_devices):
+@pytest.mark.parametrize("encoder", ["bert", "t5"])
+def test_ring_sp_training_equals_dense(tmp_path, eight_devices, encoder):
     """Full train steps with ring attention on a (data=2, seq=4) mesh match
     dense attention on a single device — sequence parallelism is exact
-    through the whole model + loss + optimizer."""
+    through the whole model + loss + optimizer. The t5 case additionally
+    exercises the per-step relative-bias rebuild across the ring."""
     import dataclasses
 
     def cfg(d, s, attn):
-        c = _tiny_cfg(d, 1, "bert")
+        c = _tiny_cfg(d, 1, encoder)
         c = c.replace(train=dataclasses.replace(c.train, optimizer="sgd"),
                       model=dataclasses.replace(c.model, attention=attn),
                       mesh=dataclasses.replace(c.mesh, data=d, seq=s))

@@ -6,8 +6,6 @@ up to float reassociation: GSPMD schedules the sharded-batch collectives of
 the scanned program differently, so per-step drift of ~1e-5 is expected on
 the 8-device mesh (observed 1.2e-5 after 12 steps), not a bug.
 """
-import tempfile
-
 import jax
 import numpy as np
 import pytest
