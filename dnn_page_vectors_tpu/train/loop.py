@@ -231,6 +231,12 @@ class Trainer:
         log = log or MetricsLogger(self.workdir)
         pages_per_step = cfg.train.batch_size
         n_dev = self.mesh.devices.size
+        # MFU next to pages/sec/chip so every logged rate is interpretable
+        # against hardware peak (same analytic counts as bench.py)
+        from dnn_page_vectors_tpu.utils.flops import (
+            device_peak_flops, train_flops_per_pair)
+        peak = device_peak_flops(self.mesh.devices.flat[0])
+        flops_pair = train_flops_per_pair(cfg, cfg.train.batch_size)
         start_step = int(state.step)
         it = (self.stacked_batches(start_step=start_step, k=scan_k)
               if scan_k > 1 else self.batches(start_step=start_step))
@@ -245,8 +251,10 @@ class Trainer:
                 jax.block_until_ready(state.params)
                 dt = time.perf_counter() - t0
                 done = int(state.step) - start_step
-                metrics["pages_per_sec_per_chip"] = (
-                    done * pages_per_step / dt / n_dev)
+                pps_chip = done * pages_per_step / dt / n_dev
+                metrics["pages_per_sec_per_chip"] = pps_chip
+                if peak:
+                    metrics["mfu"] = pps_chip * flops_pair / peak
                 metrics["step"] = int(state.step)
                 log.write(metrics)
                 last = metrics
