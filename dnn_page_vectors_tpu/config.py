@@ -37,7 +37,7 @@ class DataConfig:
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Encoder zoo settings. `encoder` selects the family."""
-    encoder: str = "cdssm"           # cdssm | kim_cnn | bert | t5
+    encoder: str = "cdssm"           # cdssm | kim_cnn | lstm | bert | t5
     embed_dim: int = 128             # token/word embedding width
     out_dim: int = 128               # final vector dimension (both towers)
     # conv families
@@ -186,6 +186,22 @@ def kim_cnn_v5e8() -> Config:
     )
 
 
+def lstm_words() -> Config:
+    """BiLSTM word-level page encoder — the reference lineage's recurrent
+    family (SURVEY.md §1 [PRIOR]; same word-tokenized corpus as config 2).
+    Sized like kim_cnn_v5e8 so the two word-family encoders are directly
+    comparable on the same data."""
+    return Config(
+        name="lstm_words",
+        data=DataConfig(tokenizer="word", corpus="toy", num_pages=1_000_000,
+                        vocab_size=100_000),
+        model=ModelConfig(encoder="lstm", embed_dim=256, model_dim=256,
+                          num_layers=1, out_dim=256),
+        mesh=MeshConfig(data=8),
+        train=TrainConfig(batch_size=4_096, steps=50_000),
+    )
+
+
 def bert_mini_v5p16() -> Config:
     """Config 3: 'Two-tower BERT-mini (query + page) with in-batch negatives
     on v5p-16' (BASELINE.json:9). BERT-mini: L=4, H=256, A=4."""
@@ -256,6 +272,7 @@ def bert_long_sp() -> Config:
 CONFIGS = {
     "cdssm_toy": cdssm_toy,
     "kim_cnn_v5e8": kim_cnn_v5e8,
+    "lstm_words": lstm_words,
     "bert_mini_v5p16": bert_mini_v5p16,
     "hardneg_v5p64": hardneg_v5p64,
     "mt5_multilingual": mt5_multilingual,

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from dnn_page_vectors_tpu.config import Config
 from dnn_page_vectors_tpu.models.cdssm import CdssmEncoder
 from dnn_page_vectors_tpu.models.kim_cnn import KimCnnEncoder
+from dnn_page_vectors_tpu.models.lstm import LstmEncoder
 from dnn_page_vectors_tpu.models.transformer import TransformerEncoder
 from dnn_page_vectors_tpu.models.two_tower import TwoTower
 
@@ -29,6 +30,11 @@ def _build_encoder(cfg: Config, vocab_size: int, name: str,
                              conv_widths=m.conv_widths,
                              conv_channels=m.conv_channels, out_dim=m.out_dim,
                              dropout=m.dropout, dtype=dtype, name=name)
+    if m.encoder == "lstm":
+        return LstmEncoder(vocab_size=vocab_size, embed_dim=m.embed_dim,
+                           hidden_dim=m.model_dim, num_layers=m.num_layers,
+                           out_dim=m.out_dim, dropout=m.dropout,
+                           dtype=dtype, name=name)
     if m.encoder in ("bert", "t5"):
         if m.attention not in ("dense", "flash", "ring"):
             raise ValueError(f"unknown attention kind {m.attention!r} "

@@ -1,0 +1,99 @@
+"""Bidirectional LSTM word encoder (SURVEY.md §1 [PRIOR]: the public
+dnn_page_vectors lineage ships LSTM page encoders alongside its CNNs; no
+reference file is citable — empty mount, SURVEY.md §0 — so this follows the
+standard masked-BiLSTM text-encoder shape behind the same TwoTower interface
+as the rest of the zoo).
+
+TPU-first layout: an LSTM's only true serial dependency is the recurrent
+h @ U matmul, so the input projection for ALL timesteps is hoisted out of
+the recurrence into one [B, L, E] @ [E, 4H] matmul that tiles onto the MXU,
+and the `lax.scan` over time carries just the [B, H] @ [H, 4H] step. Gate
+math runs in float32 regardless of the module dtype: the carry crosses
+hundreds of sequential steps, where bfloat16 rounding compounds (unlike one
+matmul accumulation, which the MXU already does in f32). Padding (id 0)
+carries (h, c) through unchanged, so the forward scan ends at the state of
+the last real token and the reversed scan at the first — page content past
+the mask can never leak into the vector (tests/test_models.py padding
+invariance).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _lstm_pass(x_proj: jnp.ndarray, mask: jnp.ndarray, u: jnp.ndarray,
+               reverse: bool) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One direction over time. x_proj: [B, L, 4H] (input projection + bias,
+    float32), mask: [B, L] bool, u: [H, 4H] recurrent weights. Returns
+    (final hidden state [B, H], per-step hidden states [B, L, H])."""
+    B = x_proj.shape[0]
+    H = u.shape[0]
+    h0 = jnp.zeros((B, H), jnp.float32)
+    c0 = jnp.zeros((B, H), jnp.float32)
+
+    def step(carry, inp):
+        h, c = carry
+        xp, m = inp                                   # [B, 4H], [B]
+        gates = xp + jnp.dot(h, u, preferred_element_type=jnp.float32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        # +1 forget-gate bias: the standard init that keeps early gradients
+        # flowing through long pages.
+        c_new = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        m = m[:, None]
+        return (jnp.where(m, h_new, h), jnp.where(m, c_new, c)), \
+            jnp.where(m, h_new, h)
+
+    xs = (jnp.moveaxis(x_proj, 1, 0), jnp.moveaxis(mask, 1, 0))
+    (h, _c), hs = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
+    return h, jnp.moveaxis(hs, 0, 1)
+
+
+class LstmEncoder(nn.Module):
+    """Stacked BiLSTM over word embeddings; encoding = concat of both
+    directions' final states -> Dense projection. hidden size = model_dim,
+    depth = num_layers (shared knobs with the transformer family)."""
+    vocab_size: int
+    embed_dim: int = 256
+    hidden_dim: int = 256
+    num_layers: int = 1
+    out_dim: int = 256
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        # ids: [B, L] word ids, 0 = pad.
+        mask = ids > 0                                            # [B, L]
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype,
+                     name="word_embed")(ids)                      # [B, L, E]
+        H = self.hidden_dim
+        finals = []
+        for layer in range(self.num_layers):
+            last = layer == self.num_layers - 1
+            outs = []
+            for tag, rev in (("fwd", False), ("bwd", True)):
+                # The bulk matmul ([B*L, E_in] @ [E_in, 4H]) runs in module
+                # dtype on the MXU; the serial gate math stays f32 (above).
+                xp = nn.Dense(4 * H, dtype=self.dtype,
+                              name=f"in_proj{layer}_{tag}")(x)
+                u = self.param(f"rec{layer}_{tag}",
+                               nn.initializers.orthogonal(), (H, 4 * H),
+                               jnp.float32)
+                h_final, hs = _lstm_pass(xp.astype(jnp.float32), mask, u, rev)
+                outs.append(hs)
+                if last:
+                    finals.append(h_final)
+            if last:
+                break  # only the final states feed the encoding
+            x = jnp.concatenate(outs, axis=-1).astype(self.dtype)  # [B, L, 2H]
+            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        h = jnp.concatenate(finals, axis=-1)                       # [B, 2H]
+        any_word = mask.any(axis=1, keepdims=True)
+        h = jnp.where(any_word, h, jnp.zeros_like(h))
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        out = nn.Dense(self.out_dim, dtype=self.dtype, name="proj")(
+            h.astype(self.dtype))
+        return out.astype(jnp.float32)                             # [B, D]

@@ -34,6 +34,16 @@ def encoder_flops_per_example(m: ModelConfig, seq_len: int) -> float:
         E, C = m.embed_dim, m.conv_channels
         conv = sum(2 * w * E * C for w in m.conv_widths) * seq_len
         return float(conv + 2 * len(m.conv_widths) * C * m.out_dim)
+    if m.encoder == "lstm":
+        # per direction per token: input proj 2*E_in*4H + recurrent 2*H*4H;
+        # layer 1 reads the embedding (E), deeper layers read [B, L, 2H]
+        H = m.model_dim
+        per_dir = 0.0
+        e_in = m.embed_dim
+        for _ in range(m.num_layers):
+            per_dir += 2 * e_in * 4 * H + 2 * H * 4 * H
+            e_in = 2 * H
+        return float(seq_len * 2 * per_dir + 2 * (2 * H) * m.out_dim)
     raise ValueError(f"no FLOP model for encoder {m.encoder!r}")
 
 

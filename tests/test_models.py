@@ -11,6 +11,8 @@ from dnn_page_vectors_tpu.models.losses import cosine_contrastive_loss, l2_norma
 CASES = [
     ("cdssm_toy", {}),
     ("kim_cnn_v5e8", {}),
+    ("lstm_words", {"model.model_dim": 64, "model.embed_dim": 64,
+                    "model.num_layers": 2, "model.out_dim": 32}),
     ("bert_mini_v5p16", {}),
     ("mt5_multilingual", {"model.num_layers": 2, "model.model_dim": 64,
                           "model.num_heads": 2, "model.mlp_dim": 128,
@@ -65,6 +67,34 @@ def test_padding_invariance():
     junk = p_ids.at[:, -5:].set(0)  # already 0 — now perturb nothing valid
     v2 = model.apply(params, junk, method="encode_page")
     np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-6)
+
+
+def test_lstm_padding_invariance():
+    """The recurrent carry must pass through padded steps untouched:
+    lengthening the pad tail cannot change the page vector."""
+    cfg = get_config("lstm_words", {"model.model_dim": 32,
+                                    "model.embed_dim": 32})
+    model = build_two_tower(cfg, vocab_size=64)
+    q_ids, p_ids = _dummy_batch(cfg)
+    params = model.init(jax.random.PRNGKey(0), q_ids, p_ids)
+    v1 = model.apply(params, p_ids, method="encode_page")
+    longer = jnp.pad(p_ids, ((0, 0), (0, 8)))  # 8 more pad steps to carry over
+    v2 = model.apply(params, longer, method="encode_page")
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_order_sensitivity():
+    """Unlike the max-pooled CNNs, the recurrent encoder must distinguish
+    word order (the reason the reference lineage carries an LSTM at all)."""
+    cfg = get_config("lstm_words", {"model.model_dim": 32,
+                                    "model.embed_dim": 32})
+    model = build_two_tower(cfg, vocab_size=64)
+    q_ids, p_ids = _dummy_batch(cfg)
+    params = model.init(jax.random.PRNGKey(0), q_ids, p_ids)
+    fwd = model.apply(params, p_ids, method="encode_page")
+    rev = model.apply(params, p_ids[:, ::-1], method="encode_page")
+    assert np.abs(np.asarray(fwd) - np.asarray(rev)).max() > 1e-4
 
 
 def test_loss_prefers_aligned_embeddings():
