@@ -130,7 +130,6 @@ def run_worker() -> None:
     state = trainer.init_state()
     _stamp("state initialized")
 
-    from dnn_page_vectors_tpu.parallel.sharding import replicated
     if scan_k > 1:
         step_fn = trainer.compiled_multi_step(state)
         it = iter(trainer.stacked_batches(k=scan_k))
@@ -138,7 +137,7 @@ def run_worker() -> None:
         step_fn = trainer.compiled_step(state)
         it = iter(trainer.batches())
     batches = [next(it) for _ in range(2 if scan_k > 1 else 4)]
-    base_rng = jax.device_put(jax.random.PRNGKey(0), replicated(trainer.mesh))
+    base_rng = trainer.base_rng()
     _stamp(f"batches staged; compiling train step (scan_k={scan_k})")
 
     for i in range(2):  # warmup + compile

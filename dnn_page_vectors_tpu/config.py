@@ -96,6 +96,13 @@ class TrainConfig:
     # per-dispatch host latency — the dominant single-chip overhead for
     # small models; log_every/checkpoint_every must be multiples of it.
     scan_steps: int = 1
+    # PRNG implementation for the per-step dropout keys. "rbg" (XLA's
+    # hardware RngBitGenerator) measured +22% train throughput over
+    # "threefry2x32" on v5e — threefry mask generation is the single
+    # largest non-matmul cost of the bert-mini step. Trade-off: rbg mask
+    # bits are not guaranteed stable across XLA versions/backends
+    # (irrelevant for dropout; param init stays threefry).
+    dropout_rng: str = "rbg"
     seed: int = 0
 
 

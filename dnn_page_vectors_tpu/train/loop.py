@@ -129,6 +129,14 @@ class Trainer:
         return ((self.cfg.data.trigrams_per_word,)
                 if self.cfg.data.tokenizer == "trigram" else ())
 
+    def base_rng(self) -> jax.Array:
+        """Replicated base key for the per-step dropout fold_in, built with
+        train.dropout_rng (default rbg — see config.py for the measured
+        threefry cost this avoids)."""
+        key = jax.random.key(self.cfg.train.seed + 1,
+                             impl=self.cfg.train.dropout_rng)
+        return jax.device_put(key, replicated(self.mesh))
+
     # -- compiled step ----------------------------------------------------
     def compiled_step(self, state: TrainState):
         if self._compiled is None:
@@ -226,8 +234,7 @@ class Trainer:
             step_fn = self.compiled_multi_step(state)
         else:
             step_fn = self.compiled_step(state)
-        base_rng = jax.device_put(jax.random.PRNGKey(cfg.train.seed + 1),
-                                  replicated(self.mesh))
+        base_rng = self.base_rng()
         log = log or MetricsLogger(self.workdir)
         pages_per_step = cfg.train.batch_size
         n_dev = self.mesh.devices.size
