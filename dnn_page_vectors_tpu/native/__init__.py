@@ -12,27 +12,47 @@ back to the pure-Python implementation.
 from __future__ import annotations
 
 import ctypes
+import glob
+import hashlib
 import os
 import subprocess
-import sys
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "trigram_hash.cpp")
-_SO = os.path.join(_DIR, "libdpv_native.so")
+_SRCS = [os.path.join(_DIR, "trigram_hash.cpp"),
+         os.path.join(_DIR, "jsonl_index.cpp")]
 
 
-def _build() -> None:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC]
+def _so_path() -> str:
+    # The library name carries a digest of the sources, so a stale build —
+    # however its mtime compares — can never be dlopen'd: a source change
+    # changes the path. (A stale same-named .so missing a newer symbol
+    # would otherwise fail the whole package import and take down the
+    # already-working fast paths with it.)
+    h = hashlib.sha1()
+    for s in _SRCS:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    return os.path.join(_DIR, f"libdpv_native_{h.hexdigest()[:12]}.so")
+
+
+def _build(so: str) -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", so, *_SRCS]
     res = subprocess.run(cmd, capture_output=True, text=True)
     if res.returncode != 0:
         raise RuntimeError(f"native build failed: {res.stderr[-2000:]}")
+    for old in glob.glob(os.path.join(_DIR, "libdpv_native*.so")):
+        if old != so:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
 
 
 def _load() -> ctypes.CDLL:
-    if (not os.path.exists(_SO)
-            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-        _build()
-    lib = ctypes.CDLL(_SO)
+    so = _so_path()
+    if not os.path.exists(so):
+        _build(so)
+    lib = ctypes.CDLL(so)
     lib.dpv_encode_trigrams.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
@@ -42,6 +62,11 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32)]
     lib.dpv_encode_trigrams_batch.restype = None
+    lib.dpv_jsonl_index.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+    lib.dpv_jsonl_index.restype = ctypes.c_int64
+    lib.dpv_free_i64.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+    lib.dpv_free_i64.restype = None
     return lib
 
 

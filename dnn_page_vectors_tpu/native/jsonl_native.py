@@ -1,0 +1,26 @@
+"""numpy-facing wrapper over the ctypes C++ jsonl line-offset indexer."""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from dnn_page_vectors_tpu.native import _lib
+
+
+def index_offsets(path: str) -> np.ndarray:
+    """Byte offsets of every non-blank line of `path` (int64), matching the
+    pure-Python scan in data/jsonl.py bit for bit. Raises OSError when the
+    file cannot be read."""
+    out = ctypes.POINTER(ctypes.c_int64)()
+    n = _lib.dpv_jsonl_index(path.encode("utf-8"), ctypes.byref(out))
+    if n < 0:
+        raise OSError(f"native jsonl index failed for {path}")
+    try:
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        return np.ctypeslib.as_array(out, shape=(n,)).astype(np.int64,
+                                                             copy=True)
+    finally:
+        if out:
+            _lib.dpv_free_i64(out)

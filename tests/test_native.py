@@ -57,6 +57,67 @@ def test_native_is_faster():
     assert t_c < t_py / 5, (t_py, t_c)
 
 
+def _py_offsets(path):
+    offsets, pos = [], 0
+    with open(path, "rb") as f:
+        for line in f:
+            if line.strip():
+                offsets.append(pos)
+            pos += len(line)
+    return np.asarray(offsets, dtype=np.int64)
+
+
+def test_jsonl_index_matches_python_exactly(tmp_path):
+    from dnn_page_vectors_tpu.native import jsonl_native
+    p = tmp_path / "corpus.jsonl"
+    # blank lines, whitespace-only lines, CRLF, unicode, no trailing newline
+    p.write_bytes(
+        b'{"page": "one"}\n'
+        b'\n'
+        b'   \t  \n'
+        b'{"page": "two"}\r\n'
+        b'{"page": "\xc3\xbcnic\xc3\xb4de"}\n'
+        b'\r\n'
+        b'{"page": "last, no newline"}')
+    np.testing.assert_array_equal(jsonl_native.index_offsets(str(p)),
+                                  _py_offsets(str(p)))
+    # degenerate files
+    empty = tmp_path / "empty.jsonl"
+    empty.write_bytes(b"")
+    assert jsonl_native.index_offsets(str(empty)).size == 0
+    blank = tmp_path / "blank.jsonl"
+    blank.write_bytes(b"\n  \n\t\n")
+    assert jsonl_native.index_offsets(str(blank)).size == 0
+
+
+def test_jsonl_index_large_and_fast(tmp_path):
+    p = tmp_path / "big.jsonl"
+    with open(p, "wb") as f:
+        for i in range(200_000):
+            f.write(b'{"query": "q%d", "page": "page text %d"}\n' % (i, i))
+    from dnn_page_vectors_tpu.native import jsonl_native
+    t0 = time.perf_counter()
+    native_off = jsonl_native.index_offsets(str(p))
+    t_c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    py_off = _py_offsets(str(p))
+    t_py = time.perf_counter() - t0
+    np.testing.assert_array_equal(native_off, py_off)
+    assert len(native_off) == 200_000
+    assert t_c < t_py, (t_py, t_c)  # conservative: typically ~10x
+
+
+def test_jsonl_corpus_uses_native_index(tmp_path):
+    from dnn_page_vectors_tpu.data.jsonl import JsonlCorpus
+    p = tmp_path / "c.jsonl"
+    p.write_text('{"query": "q0", "page": "p0"}\n\n{"page": "p1"}\n')
+    c = JsonlCorpus(str(p))
+    assert c.native_index  # the fast path actually ran, not the fallback
+    assert c.num_pages == 2
+    assert c.page_text(1) == "p1"
+    assert c.query_text(0) == "q0"
+
+
 def test_tokenizer_uses_native_by_default():
     tok = TrigramTokenizer(buckets=1024, max_words=8, k=4)
     assert tok._native is not None
