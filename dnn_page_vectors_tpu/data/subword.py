@@ -14,7 +14,10 @@ lazy max-heap — one merge touches only the words containing the pair, so
 real-scale vocabularies (30,522 BERT / 250,112 mT5; VERDICT r1 #3) train in
 seconds instead of the O(merges x corpus) of the naive loop. Encoding is
 greedy longest-match, which matches WordPiece inference and is a close,
-deterministic stand-in for unigram-LM sampling-free SentencePiece inference.
+deterministic stand-in for unigram-LM sampling-free SentencePiece inference;
+batch encoding runs in C++ (native/bpe_encode.cpp, ~6x, bit-equal and
+self-checked with Python fallback) because the host-side matcher is what
+feeds the device at bulk-embed rates.
 """
 from __future__ import annotations
 
@@ -202,7 +205,36 @@ class SubwordTokenizer:
                 pos += 1
         return out
 
+    def _native_encoder(self):
+        """C++ greedy matcher (native/bpe_encode.cpp), built lazily and
+        self-checked against the Python path on a probe covering Unicode,
+        UNK fallback, and mid-word truncation — on any disagreement or
+        build failure the tokenizer silently stays pure-Python (same
+        contract as data/trigram.py)."""
+        if not hasattr(self, "_native"):
+            self._native = None
+            try:
+                from dnn_page_vectors_tpu.native import subword_native
+                enc = subword_native.shared_encoder(self.vocab)
+                probe = ["ab cd ef", "ünïcôdé wörds ärë fïne",
+                         "日本語 テキスト", "", "  spaced out ",
+                         "x" * 300,
+                         " ".join("pq" for _ in range(self.max_tokens + 8))]
+                want = np.stack([self.encode(t) for t in probe])
+                got = enc.encode_batch(probe, self.max_tokens, UNK_ID)
+                if (got == want).all():
+                    self._native = enc
+            except Exception:
+                self._native = None
+        return self._native
+
     def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        native = self._native_encoder()
+        if native is not None:
+            try:
+                return native.encode_batch(texts, self.max_tokens, UNK_ID)
+            except Exception:
+                pass  # fallback contract: never crash where Python works
         return np.stack([self.encode(t) for t in texts])
 
     def tokens(self, text: str) -> List[str]:

@@ -19,7 +19,9 @@ import subprocess
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "trigram_hash.cpp"),
-         os.path.join(_DIR, "jsonl_index.cpp")]
+         os.path.join(_DIR, "jsonl_index.cpp"),
+         os.path.join(_DIR, "bpe_encode.cpp")]
+_HDRS = [os.path.join(_DIR, "unicode_util.h")]
 
 
 def _so_path() -> str:
@@ -29,7 +31,7 @@ def _so_path() -> str:
     # would otherwise fail the whole package import and take down the
     # already-working fast paths with it.)
     h = hashlib.sha1()
-    for s in _SRCS:
+    for s in _SRCS + _HDRS:
         with open(s, "rb") as f:
             h.update(f.read())
     return os.path.join(_DIR, f"libdpv_native_{h.hexdigest()[:12]}.so")
@@ -67,6 +69,17 @@ def _load() -> ctypes.CDLL:
     lib.dpv_jsonl_index.restype = ctypes.c_int64
     lib.dpv_free_i64.argtypes = [ctypes.POINTER(ctypes.c_int64)]
     lib.dpv_free_i64.restype = None
+    lib.dpv_bpe_new.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    lib.dpv_bpe_new.restype = ctypes.c_void_p
+    lib.dpv_bpe_free.argtypes = [ctypes.c_void_p]
+    lib.dpv_bpe_free.restype = None
+    lib.dpv_bpe_encode_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.dpv_bpe_encode_batch.restype = None
     return lib
 
 

@@ -1,0 +1,87 @@
+"""numpy-facing wrapper over the ctypes C++ greedy BPE encoder.
+
+One NativeBpeEncoder per piece vocab: the constructor ships the vocab to
+C++ once (a 250k-piece hash map is far too costly to rebuild per batch);
+encode_batch then runs the whole batch without touching the interpreter
+(ctypes drops the GIL, so prefetch threads scale across host cores).
+`shared_encoder` dedups by vocab content — the query and page tokenizers
+share one vocab dict (loader.py) and must not build two identical maps.
+"""
+from __future__ import annotations
+
+import collections
+import ctypes
+import hashlib
+from typing import Dict, Sequence
+
+import numpy as np
+
+from dnn_page_vectors_tpu.native import _lib
+
+
+class NativeBpeEncoder:
+    def __init__(self, vocab: Dict[str, int]):
+        blob, ids = _vocab_blob(vocab)
+        self._init(blob, ids)
+
+    def _init(self, blob: bytes, ids: np.ndarray) -> None:
+        self._blob = blob          # keep alive for the c_char_p view
+        self._h = _lib.dpv_bpe_new(
+            blob, len(blob),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(ids))
+
+    def encode_batch(self, texts: Sequence[str], max_tokens: int,
+                     unk_id: int) -> np.ndarray:
+        n = len(texts)
+        out = np.zeros((n, max_tokens), dtype=np.int32)
+        if n == 0:
+            return out
+        # surrogatepass: a lone surrogate (e.g. a "\ud800" JSON escape)
+        # must encode rather than raise; C++ decodes it back to one
+        # codepoint, finds no piece, and emits UNK — exactly the Python
+        # path's behavior for that character.
+        blobs = [t.encode("utf-8", "surrogatepass") for t in texts]
+        lens = np.asarray([len(b) for b in blobs], dtype=np.int64)
+        concat = b"".join(blobs)
+        _lib.dpv_bpe_encode_batch(
+            self._h, concat, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, max_tokens, unk_id,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            _lib.dpv_bpe_free(h)
+            self._h = None
+
+
+def _vocab_blob(vocab: Dict[str, int]) -> tuple[bytes, np.ndarray]:
+    pieces = list(vocab.keys())
+    # pieces derive from str.split() words, so they can never contain the
+    # '\n' separator (or any whitespace)
+    blob = "\n".join(pieces).encode("utf-8")
+    ids = np.asarray([vocab[p] for p in pieces], dtype=np.int32)
+    return blob, ids
+
+
+_CACHE: "collections.OrderedDict[bytes, NativeBpeEncoder]" = \
+    collections.OrderedDict()
+_CACHE_MAX = 4
+
+
+def shared_encoder(vocab: Dict[str, int]) -> NativeBpeEncoder:
+    """Content-keyed encoder cache (hashing the blob is milliseconds; the
+    250k-piece map build it skips is not)."""
+    blob, ids = _vocab_blob(vocab)
+    key = hashlib.sha1(blob + ids.tobytes()).digest()
+    enc = _CACHE.get(key)
+    if enc is None:
+        enc = NativeBpeEncoder.__new__(NativeBpeEncoder)
+        enc._init(blob, ids)
+        _CACHE[key] = enc
+        while len(_CACHE) > _CACHE_MAX:
+            _CACHE.popitem(last=False)
+    else:
+        _CACHE.move_to_end(key)
+    return enc

@@ -123,3 +123,53 @@ def test_tokenizer_uses_native_by_default():
     assert tok._native is not None
     np.testing.assert_array_equal(tok.encode("hello world"),
                                   tok._encode_py("hello world"))
+
+
+def _trained_subword(style):
+    from dnn_page_vectors_tpu.data.subword import SubwordTokenizer
+    corpus = ToyCorpus(num_pages=300, seed=5)
+    texts = [corpus.page_text(i) for i in range(300)]
+    return SubwordTokenizer.train(texts, vocab_size=600, style=style,
+                                  max_tokens=24), texts
+
+
+@pytest.mark.parametrize("style", ["wordpiece", "sentencepiece"])
+def test_bpe_native_matches_python_exactly(style):
+    tok, texts = _trained_subword(style)
+    assert tok._native_encoder() is not None  # fast path actually active
+    cases = texts[:50] + [
+        "", "a", "unknownwordxyz", "  spaced   out  ",
+        "ünïcôdé wörds ärë fïne", "日本語 テキスト",
+        "x" * 500, "\tmixed\nwhitespace\r here",
+        " nbsp separated　words",
+        "lone " + chr(0xD800) + " surrogate",  # json.loads(chr(92)+"ud800") case
+        " ".join("tok" for _ in range(64)),  # mid-word truncation
+    ]
+    want = np.stack([tok.encode(t) for t in cases])
+    got = tok.encode_batch(cases)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bpe_native_is_faster():
+    tok, texts = _trained_subword("wordpiece")
+    batch = [texts[i % len(texts)] for i in range(2_000)]
+    native = tok._native_encoder()
+    assert native is not None
+    native.encode_batch(batch[:10], tok.max_tokens, 1)  # warm
+    t0 = time.perf_counter()
+    native.encode_batch(batch, tok.max_tokens, 1)
+    t_c = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.stack([tok.encode(t) for t in batch])
+    t_py = time.perf_counter() - t0
+    assert t_c < t_py / 3, (t_py, t_c)  # measured ~6x; /3 rides out noise
+
+
+def test_bpe_shared_encoder_cache():
+    """Query and page tokenizers share one vocab dict (loader.py) — they
+    must share one C++ map, not build two 250k-piece copies."""
+    from dnn_page_vectors_tpu.native import subword_native
+    tok, _ = _trained_subword("wordpiece")
+    a = subword_native.shared_encoder(tok.vocab)
+    b = subword_native.shared_encoder(dict(tok.vocab))  # equal content
+    assert a is b
