@@ -31,6 +31,10 @@ class DataConfig:
     num_topics: int = 64             # toy-corpus topics; fewer => more
                                      # near-duplicate pages per topic, harder
                                      # within-topic retrieval (mining tests)
+    # >1 chunks subword batch encoding across host threads (the C++ matcher
+    # releases the GIL). One thread feeds one chip (~164k pages/s measured);
+    # multi-chip hosts (v5e-8) need roughly one thread per 1-2 chips.
+    tokenize_threads: int = 1
     seed: int = 0
 
 

@@ -101,6 +101,7 @@ def build_tokenizer(cfg: Config, corpus, cache_dir: Optional[str] = None):
             if cache:
                 tok.save(cache)
         q = SubwordTokenizer(tok.vocab, style=tok.style, max_tokens=d.query_len)
+        q.threads = tok.threads = d.tokenize_threads
         return q, tok
     raise ValueError(f"unknown tokenizer {d.tokenizer!r}")
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import heapq
 import json
+import threading
 from typing import Dict, Iterable, List, Sequence, Tuple
 
 import numpy as np
@@ -117,6 +118,31 @@ def _train_bpe(word_counts: Dict[Tuple[str, ...], int], num_merges: int,
     return merges
 
 
+_POOL = None
+_POOL_SIZE = 0
+_POOL_LOCK = threading.Lock()
+
+
+def _threaded_encode(native, texts: Sequence[str], max_tokens: int,
+                     k: int) -> np.ndarray:
+    """Chunk the batch over a shared thread pool. Correct because chunks are
+    independent and the C ABI call drops the GIL for its whole duration."""
+    global _POOL, _POOL_SIZE
+    with _POOL_LOCK:  # prefetch producers may race first use / growth
+        if _POOL is None or _POOL_SIZE < k:
+            import concurrent.futures
+            if _POOL is not None:
+                _POOL.shutdown(wait=False)
+            _POOL = concurrent.futures.ThreadPoolExecutor(max_workers=k)
+            _POOL_SIZE = k
+    n = len(texts)
+    bounds = [(i * n // k, (i + 1) * n // k) for i in range(k)]
+    parts = _POOL.map(
+        lambda se: native.encode_batch(texts[se[0]:se[1]], max_tokens, UNK_ID),
+        bounds)
+    return np.concatenate(list(parts), axis=0)
+
+
 class SubwordTokenizer:
     """BPE-core subword tokenizer with WordPiece / SentencePiece surfaces."""
 
@@ -129,6 +155,11 @@ class SubwordTokenizer:
         # provenance (config vocab_size, corpus fingerprint) — lets the
         # loader detect a stale cache instead of silently reusing it
         self.meta = meta or {}
+        # >1 chunks native batch encoding across a thread pool (the C++
+        # matcher releases the GIL, so it scales across host cores — a
+        # v5e-8 host must feed ~8x one chip's embed rate). Set from
+        # config.data.tokenize_threads by the loader.
+        self.threads = 1
 
     # -- training ---------------------------------------------------------
     @classmethod
@@ -232,6 +263,9 @@ class SubwordTokenizer:
         native = self._native_encoder()
         if native is not None:
             try:
+                k = min(self.threads, len(texts) // 256)  # >=256 texts/chunk
+                if k > 1:
+                    return _threaded_encode(native, texts, self.max_tokens, k)
                 return native.encode_batch(texts, self.max_tokens, UNK_ID)
             except Exception:
                 pass  # fallback contract: never crash where Python works

@@ -173,3 +173,17 @@ def test_bpe_shared_encoder_cache():
     a = subword_native.shared_encoder(tok.vocab)
     b = subword_native.shared_encoder(dict(tok.vocab))  # equal content
     assert a is b
+
+
+def test_bpe_threaded_encode_matches_single():
+    """data.tokenize_threads > 1 chunks the batch over a thread pool; the
+    result must be row-identical to the single-call encode regardless of
+    chunk boundaries (1024 texts -> 4 chunks of 256)."""
+    from dnn_page_vectors_tpu.data import subword
+    tok, texts = _trained_subword("wordpiece")
+    batch = [texts[i % len(texts)] for i in range(1_024)]
+    want = tok.encode_batch(batch)
+    tok.threads = 4
+    got = tok.encode_batch(batch)
+    np.testing.assert_array_equal(got, want)
+    assert subword._POOL is not None  # the threaded path actually dispatched
