@@ -84,3 +84,44 @@ def test_subword_deterministic():
     v1 = SubwordTokenizer.train(texts, vocab_size=48).vocab
     v2 = SubwordTokenizer.train(texts, vocab_size=48).vocab
     assert v1 == v2
+
+
+def test_train_batcher_per_process_slices_cover_global_batch():
+    """Multi-host contract (VERDICT r1 #6): P processes each materialize
+    only their contiguous slice, and the concatenation over process_index
+    reproduces the single-process global batch exactly — same ids, same
+    tokens, same order — for several steps and across an epoch boundary."""
+    from dnn_page_vectors_tpu.data.loader import TrainBatcher
+    corpus = ToyCorpus(num_pages=96, seed=2)
+    texts = [corpus.page_text(i) for i in range(96)]
+    tok = WordTokenizer.train(texts, vocab_size=500)
+    P, B = 4, 32
+    glob = iter(TrainBatcher(corpus, tok, tok, batch_size=B, seed=7,
+                             process_index=0, process_count=1))
+    locals_ = [iter(TrainBatcher(corpus, tok, tok, batch_size=B, seed=7,
+                                 process_index=p, process_count=P))
+               for p in range(P)]
+    for _ in range(7):  # 96/32 = 3 steps/epoch -> crosses epoch boundaries
+        want = next(glob)
+        parts = [next(it) for it in locals_]
+        for key in want:
+            got = np.concatenate([part[key] for part in parts], axis=0)
+            np.testing.assert_array_equal(got, want[key], err_msg=key)
+        assert parts[0]["page"].shape[0] == B // P  # truly a slice
+
+
+def test_train_batcher_resume_matches_uninterrupted():
+    """start_step=k reproduces the tail of an uninterrupted stream (the
+    data-order half of checkpoint resume, §5.4)."""
+    from dnn_page_vectors_tpu.data.loader import TrainBatcher
+    corpus = ToyCorpus(num_pages=64, seed=3)
+    texts = [corpus.page_text(i) for i in range(64)]
+    tok = WordTokenizer.train(texts, vocab_size=400)
+    full = iter(TrainBatcher(corpus, tok, tok, batch_size=16, seed=1))
+    for _ in range(5):
+        next(full)
+    resumed = iter(TrainBatcher(corpus, tok, tok, batch_size=16, seed=1,
+                                start_step=5))
+    for _ in range(3):
+        np.testing.assert_array_equal(next(resumed)["page_id"],
+                                      next(full)["page_id"])
