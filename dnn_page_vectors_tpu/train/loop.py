@@ -262,6 +262,14 @@ class Trainer:
                 metrics["pages_per_sec_per_chip"] = pps_chip
                 if peak:
                     metrics["mfu"] = pps_chip * flops_pair / peak
+                try:  # HBM headroom next to throughput (memory_stats()
+                      # is None on CPU and on the tunneled axon backend)
+                    stats = self.mesh.devices.flat[0].memory_stats()
+                    if stats and "bytes_in_use" in stats:
+                        metrics["hbm_gb_in_use"] = round(
+                            stats["bytes_in_use"] / 2**30, 3)
+                except Exception:
+                    pass
                 metrics["step"] = int(state.step)
                 log.write(metrics)
                 last = metrics
