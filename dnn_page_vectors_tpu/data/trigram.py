@@ -50,9 +50,12 @@ class TrigramTokenizer:
                 from dnn_page_vectors_tpu.native import trigram_native
                 # Self-check: the two paths must agree bit-exactly or the
                 # vector store is not reproducible across hosts (ADVICE r1).
-                # The probe covers Unicode whitespace (NBSP, LS) and a word
-                # longer than any fixed C buffer.
-                probe = "ab cd ef " + "x" * 300 + " fin"
+                # The probe covers Unicode whitespace (NBSP, LS), multi-byte
+                # words, a lone surrogate, and a word longer than any fixed
+                # C buffer — a stale .so that mishandles any of these must
+                # disable itself here, not diverge silently in production.
+                probe = ("ab cd ef " + "x" * 300 + " fin"
+                         + " 日本語 ünï " + chr(0xD800) + "g")
                 native = trigram_native.encode(probe, self.buckets,
                                                self.max_words, self.k)
                 if (native == self._encode_py(probe)).all():
@@ -69,7 +72,10 @@ class TrigramTokenizer:
         for wi, word in enumerate(text.split()[: self.max_words]):
             tgs = word_trigrams(word)[: self.k]
             for ti, tg in enumerate(tgs):
-                out[wi, ti] = 1 + fnv1a(tg.encode("utf-8")) % self.buckets
+                # surrogatepass: lone surrogates (a "\ud800" JSON escape in
+                # a real corpus) must hash, not crash the loader
+                data = tg.encode("utf-8", "surrogatepass")
+                out[wi, ti] = 1 + fnv1a(data) % self.buckets
         return out
 
     def encode(self, text: str) -> np.ndarray:

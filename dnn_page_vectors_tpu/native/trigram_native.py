@@ -11,7 +11,9 @@ from dnn_page_vectors_tpu.native import _lib
 
 def encode(text: str, buckets: int, max_words: int, k: int) -> np.ndarray:
     out = np.zeros((max_words, k), dtype=np.int32)
-    data = text.encode("utf-8")
+    # surrogatepass matches the Python path: the C++ side decodes the
+    # surrogate's 3-byte sequence as one codepoint and hashes its bytes
+    data = text.encode("utf-8", "surrogatepass")
     _lib.dpv_encode_trigrams(
         data, len(data), buckets, max_words, k,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
@@ -24,7 +26,7 @@ def encode_batch(texts: Sequence[str], buckets: int, max_words: int,
     out = np.zeros((n, max_words, k), dtype=np.int32)
     if n == 0:
         return out
-    blobs = [t.encode("utf-8") for t in texts]
+    blobs = [t.encode("utf-8", "surrogatepass") for t in texts]
     lens = np.asarray([len(b) for b in blobs], dtype=np.int64)
     concat = b"".join(blobs)
     _lib.dpv_encode_trigrams_batch(

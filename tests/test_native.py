@@ -187,3 +187,32 @@ def test_bpe_threaded_encode_matches_single():
     got = tok.encode_batch(batch)
     np.testing.assert_array_equal(got, want)
     assert subword._POOL is not None  # the threaded path actually dispatched
+
+
+def test_native_fuzz_equality_random_unicode():
+    """Randomized bit-equality sweep for both native tokenizer paths over a
+    seeded unicode soup: ASCII, accents, CJK, emoji, every Python split()
+    whitespace class, combining marks, and lone surrogates."""
+    import random
+    rng = random.Random(0)
+    pool = (list("abcdefgh0123 ")
+            + list("äöüßéñç")
+            + list("日本語中文한국")
+            + ["🙂", "👍", "́"]          # astral + combining
+            + ["\t", "\n", "\r", "\x0b", "\x0c", "\x1c", "\x85",
+               "\xa0", " ", " ", " ", " ", "　"]
+            + [chr(0xD800)])                   # lone surrogate
+    texts = ["".join(rng.choice(pool) for _ in range(rng.randint(0, 60)))
+             for _ in range(300)]
+
+    tok = TrigramTokenizer(buckets=512, max_words=16, k=4)
+    assert tok._native is not None
+    for t in texts:
+        np.testing.assert_array_equal(tok.encode(t), tok._encode_py(t),
+                                      err_msg=repr(t))
+
+    sub, _ = _trained_subword("sentencepiece")
+    assert sub._native_encoder() is not None
+    want = np.stack([sub.encode(t) for t in texts])
+    got = sub.encode_batch(texts)
+    np.testing.assert_array_equal(got, want)
