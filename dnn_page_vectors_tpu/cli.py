@@ -4,6 +4,7 @@
   python -m dnn_page_vectors_tpu.cli embed --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli eval  --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli mine  --config hardneg_v5p64
+  python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --query "..."
   python -m dnn_page_vectors_tpu.cli pipeline --config hardneg_v5p64 --rounds 4
 
 Any config field is overridable with --set section.field=value; every flag
@@ -68,7 +69,11 @@ def _restore_or_init(cfg, trainer):
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="dnn_page_vectors_tpu")
     ap.add_argument("command", choices=["train", "embed", "eval", "mine",
-                                        "pipeline", "configs"])
+                                        "search", "pipeline", "configs"])
+    ap.add_argument("--query", default=None,
+                    help="search: free-text query to embed and retrieve for")
+    ap.add_argument("--topk", type=int, default=None,
+                    help="search: results to return (default eval.recall_k)")
     ap.add_argument("--rounds", type=int, default=2,
                     help="pipeline: train->embed->mine->train rounds")
     ap.add_argument("--config", default="cdssm_toy", choices=sorted(CONFIGS))
@@ -84,6 +89,8 @@ def main(argv=None) -> None:
         for name in sorted(CONFIGS):
             print(name)
         return
+    if args.command == "search" and not args.query:
+        ap.error("search requires --query TEXT")  # before any heavy setup
 
     cfg = get_config(args.config, _parse_overrides(args.overrides))
     if args.workdir:
@@ -160,6 +167,31 @@ def main(argv=None) -> None:
                                      k=cfg.eval.recall_k)
         print(json.dumps({f"recall@{cfg.eval.recall_k}": recall,
                           "num_queries": nq}, sort_keys=True))
+    elif args.command == "search":
+        # ad-hoc retrieval over the embedded store (the query-time half of
+        # call stack §4.3, exposed as a product surface): embed the query
+        # text with the query tower, stream the store through the sharded
+        # top-k merge, print ids + scores + page snippets.
+        import numpy as np
+
+        from dnn_page_vectors_tpu.ops.topk import topk_over_store
+        store = VectorStore(store_dir)
+        store_step = store.manifest.get("model_step")
+        if store_step != int(state.step):
+            import sys
+            print(f"WARNING: store embedded at model step {store_step} but "
+                  f"the restored checkpoint is at step {int(state.step)} — "
+                  "query and page vectors come from DIFFERENT params; "
+                  "re-run 'embed' for meaningful rankings", file=sys.stderr)
+        k = args.topk or cfg.eval.recall_k
+        qv = embedder.embed_texts([args.query], tower="query")
+        scores, ids = topk_over_store(np.asarray(qv, np.float32), store,
+                                      embedder.mesh, k=k)
+        results = [
+            {"page_id": int(i), "score": round(float(s), 4),
+             "snippet": trainer.corpus.page_text(int(i))[:160]}
+            for s, i in zip(scores[0], ids[0]) if i >= 0]
+        print(json.dumps({"query": args.query, "results": results}))
     elif args.command == "mine":
         from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives
         store = VectorStore(store_dir)

@@ -123,3 +123,42 @@ def test_run_pipeline_end_to_end(tmp_path):
                         dim=cfg.model.out_dim)
     assert store.num_vectors == 600
     assert store.manifest["model_step"] == 120
+
+
+def test_cli_search_returns_gold_page(tmp_path, capsys):
+    """`cli search --query <text>` embeds the query and retrieves from the
+    store: after a short train + embed, the gold page for a training query
+    must appear in the top-k results with a snippet."""
+    import json
+
+    from dnn_page_vectors_tpu import cli
+
+    wd = str(tmp_path)
+    base = ["--config", "cdssm_toy", "--workdir", wd,
+            "--set", "data.num_pages=400",
+            "--set", "data.trigram_buckets=2048",
+            "--set", "model.embed_dim=48",
+            "--set", "model.conv_channels=96",
+            "--set", "model.out_dim=48",
+            "--set", "train.batch_size=64",
+            "--set", "train.warmup_steps=10",
+            "--set", "train.learning_rate=2e-3",
+            "--set", "train.log_every=1000",
+            "--set", "eval.embed_batch_size=128",
+            "--set", "mesh.data=1"]
+    cli.main(["train"] + base + ["--steps", "80"])
+    cli.main(["embed"] + base)
+    capsys.readouterr()
+
+    from dnn_page_vectors_tpu.data.toy import ToyCorpus
+    corpus = ToyCorpus(num_pages=400, seed=0)
+    query = corpus.query_text(7)
+    cli.main(["search"] + base + ["--query", query, "--topk", "5"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["query"] == query
+    assert len(out["results"]) == 5
+    assert all(r["snippet"] for r in out["results"])
+    assert 7 in [r["page_id"] for r in out["results"]]
+    # ranked: scores non-increasing
+    scores = [r["score"] for r in out["results"]]
+    assert scores == sorted(scores, reverse=True)
