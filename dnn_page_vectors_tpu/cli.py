@@ -51,8 +51,12 @@ def _trainer(cfg):
 
 def _embedder(cfg, trainer, state):
     from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+    from dnn_page_vectors_tpu.parallel.multihost import inference_mesh
+    # single-process: the trainer's mesh; multi-process: a process-local
+    # mesh — embed/eval/mine run per-host independent (parallel/multihost.py)
+    mesh = inference_mesh(cfg.mesh, trainer.mesh)
     return BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
-                        trainer.mesh, query_tok=trainer.query_tok)
+                        mesh, query_tok=trainer.query_tok)
 
 
 def _restore_or_init(cfg, trainer):
@@ -69,7 +73,8 @@ def _restore_or_init(cfg, trainer):
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="dnn_page_vectors_tpu")
     ap.add_argument("command", choices=["train", "embed", "eval", "mine",
-                                        "search", "pipeline", "configs"])
+                                        "search", "pipeline", "configs",
+                                        "init-store", "merge-store"])
     ap.add_argument("--query", default=None,
                     help="search: free-text query to embed and retrieve for")
     ap.add_argument("--topk", type=int, default=None,
@@ -81,6 +86,11 @@ def main(argv=None) -> None:
                     metavar="section.field=value")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--start", type=int, default=0,
+                    help="embed: first page id (store-shard aligned) — for "
+                         "manual fleet sharding, one corpus slice per process")
+    ap.add_argument("--stop", type=int, default=None,
+                    help="embed: one-past-last page id (shard aligned)")
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace under workdir/trace")
     args = ap.parse_args(argv)
@@ -102,8 +112,39 @@ def main(argv=None) -> None:
     from dnn_page_vectors_tpu.infer.vector_store import VectorStore
     from dnn_page_vectors_tpu.utils.profiling import maybe_profile
 
-    trainer = _trainer(cfg)
     store_dir = os.path.join(cfg.workdir, "store")
+
+    # Store-admin commands dispatch BEFORE the trainer build: they need no
+    # model, tokenizer, or device — just the store directory and (for
+    # init-store) the latest checkpoint step.
+    if args.command == "merge-store":
+        # Manual-fleet step 3: fold writer manifests into the main one once
+        # every slice finished. (The jax.distributed path does this itself
+        # behind a barrier; readers work without it either way — shards()
+        # always sees the union view.)
+        store = VectorStore(store_dir)
+        store.merge_writers()
+        print(json.dumps({"store": store_dir,
+                          "shards": len(store.manifest["shards"]),
+                          "vectors": store.num_vectors}))
+        return
+
+    if args.command == "init-store":
+        # Manual-fleet step 1 (docs/SCALING.md): ONE invocation prepares and
+        # stamps the store before N uncoordinated `embed --start/--stop`
+        # processes write into it — those processes have no barrier between
+        # them, so the reset-if-stale decision must happen exactly once here.
+        from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+        mgr = CheckpointManager(os.path.join(cfg.workdir, "ckpt"))
+        model_step = mgr.latest_step() or 0
+        mgr.close()
+        store = VectorStore(store_dir, dim=cfg.model.out_dim,
+                            shard_size=cfg.eval.store_shard_size)
+        store.ensure_model_step(model_step)
+        print(json.dumps({"store": store_dir, "model_step": model_step}))
+        return
+
+    trainer = _trainer(cfg)
 
     if args.command == "pipeline":
         # train -> embed -> mine -> continue-train rounds (SURVEY.md §4.4)
@@ -145,28 +186,63 @@ def main(argv=None) -> None:
     mgr.close()
     embedder = _embedder(cfg, trainer, state)
 
+    from dnn_page_vectors_tpu.parallel.multihost import barrier, process_info
+    pi, pc = process_info()
+    model_step = int(state.step)
+    fleet = args.start != 0 or args.stop is not None
+
     if args.command == "embed":
-        store = VectorStore(store_dir, dim=cfg.model.out_dim)
         # vectors from an older checkpoint are stale, not resumable work: a
         # finished shard only counts if it came from the same model step.
         # An unstamped store with shards is ambiguous -> reset (fresh stores
-        # have no shards, so resetting them is free).
-        model_step = int(state.step)
-        if store.manifest.get("model_step") != model_step:
-            store.reset()
-        store.manifest["model_step"] = model_step
-        store._flush_manifest()
+        # have no shards, so resetting them is free). Under multi-process,
+        # process 0 prepares/stamps the store before anyone writes. Manual
+        # --start/--stop fleet slices must NOT each make that decision (no
+        # barrier between them -> a late starter could reset a sibling's
+        # fresh shards), so they require a prior `init-store` run instead —
+        # and read the store's stamped geometry rather than their own
+        # eval.store_shard_size (a slice launched with a divergent override
+        # must not silently re-shape the shared store).
+        writer = None
+        if fleet:
+            try:
+                store = VectorStore(store_dir)
+            except FileNotFoundError:
+                raise SystemExit(
+                    f"no store at {store_dir}; run 'init-store' once before "
+                    "launching --start/--stop embed slices")
+            if store.manifest.get("model_step") != model_step:
+                raise SystemExit(
+                    f"store at {store_dir} is stamped for model step "
+                    f"{store.manifest.get('model_step')} but the checkpoint "
+                    f"is at {model_step}; run 'init-store' once before "
+                    "launching --start/--stop embed slices")
+            # writer id: the slice's first shard index (disjoint ranges ->
+            # disjoint writer manifests; see VectorStore multi-writer notes)
+            writer = args.start // store.manifest["shard_size"]
+        elif pi == 0:
+            VectorStore(store_dir, dim=cfg.model.out_dim,
+                        shard_size=cfg.eval.store_shard_size
+                        ).ensure_model_step(model_step)
+        barrier("store_ready")
+        if pc > 1:
+            writer = pi          # the jax.distributed multi-writer path
+        store = VectorStore(store_dir, dim=cfg.model.out_dim,
+                            writer_id=writer)
         with maybe_profile(args.profile, cfg.workdir):
-            embedder.embed_corpus(trainer.corpus, store)
-        print(json.dumps({"embedded": store.num_vectors,
-                          "model_step": model_step}))
+            embedder.embed_corpus(trainer.corpus, store,
+                                  start=args.start, stop=args.stop)
+        if pi == 0:
+            print(json.dumps({"embedded": store.num_vectors,
+                              "model_step": model_step}))
     elif args.command == "eval":
         from dnn_page_vectors_tpu.evals.recall import evaluate_recall
         store = VectorStore(store_dir)
         recall, nq = evaluate_recall(embedder, trainer.corpus, store,
                                      k=cfg.eval.recall_k)
-        print(json.dumps({f"recall@{cfg.eval.recall_k}": recall,
-                          "num_queries": nq}, sort_keys=True))
+        if pi == 0:
+            print(json.dumps({f"recall@{cfg.eval.recall_k}": recall,
+                              "num_queries": nq}, sort_keys=True))
     elif args.command == "search":
         # ad-hoc retrieval over the embedded store (the query-time half of
         # call stack §4.3, exposed as a product surface): embed the query
@@ -191,15 +267,20 @@ def main(argv=None) -> None:
             {"page_id": int(i), "score": round(float(s), 4),
              "snippet": trainer.corpus.page_text(int(i))[:160]}
             for s, i in zip(scores[0], ids[0]) if i >= 0]
-        print(json.dumps({"query": args.query, "results": results}))
+        if pi == 0:
+            print(json.dumps({"query": args.query, "results": results}))
     elif args.command == "mine":
         from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives
         store = VectorStore(store_dir)
-        negs = mine_hard_negatives(embedder, trainer.corpus, store,
-                                   num_negatives=cfg.train.hard_negatives or 7)
         out = os.path.join(cfg.workdir, "hard_negatives.npy")
-        negs.save(out)
-        print(json.dumps({"mined": list(negs.table.shape), "path": out}))
+        negs = mine_hard_negatives(embedder, trainer.corpus, store,
+                                   num_negatives=cfg.train.hard_negatives or 7,
+                                   out_path=(out if pc == 1 else None))
+        if pc > 1 and pi == 0:
+            negs.save(out)
+        barrier("mine_saved")
+        if pi == 0:
+            print(json.dumps({"mined": list(negs.table.shape), "path": out}))
 
 
 if __name__ == "__main__":

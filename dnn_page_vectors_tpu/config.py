@@ -115,6 +115,9 @@ class EvalConfig:
     recall_k: int = 10               # Recall@10 query->page (BASELINE.json:2)
     eval_queries: int = 1_000
     embed_batch_size: int = 512
+    # vector-store shard rows: the resume/parallelism unit of the bulk-embed
+    # job (one shard = one manifest entry = one fleet work item)
+    store_shard_size: int = 65_536
 
 
 @dataclasses.dataclass(frozen=True)

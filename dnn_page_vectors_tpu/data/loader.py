@@ -251,7 +251,12 @@ def prefetch_to_device(it: Iterator[Batch], sharding: Optional[Any] = None,
 
     buf: collections.deque[Any] = collections.deque()
 
-    multiprocess = jax.process_count() > 1
+    # Assemble-from-local-slices only when the target sharding actually spans
+    # other processes (the SPMD training mesh). A process-LOCAL mesh in a
+    # multi-process job (multihost embed) takes the plain device_put path —
+    # its batches are complete, not per-process slices.
+    multiprocess = (jax.process_count() > 1 and sharding is not None
+                    and not sharding.is_fully_addressable)
 
     def _put(batch: Batch) -> Any:
         if sharding is None:

@@ -45,15 +45,25 @@ def recall_at_k(query_vecs: np.ndarray, page_ids: np.ndarray,
     return hits / max(nq, 1)
 
 
+def hits_from_store(query_vecs: np.ndarray, store: VectorStore,
+                    gold_ids: np.ndarray, mesh, k: int = 10,
+                    query_batch: int = 1024, chunk: int = 8192) -> int:
+    """Number of queries whose gold id lands in the store-streamed top-k."""
+    if query_vecs.shape[0] == 0:
+        return 0
+    _, retrieved = topk_over_store(
+        np.asarray(query_vecs, np.float32), store, mesh, k=k,
+        chunk=chunk, query_batch=query_batch)
+    return int((retrieved == gold_ids[:, None]).any(axis=1).sum())
+
+
 def recall_from_store(query_vecs: np.ndarray, store: VectorStore,
                       gold_ids: np.ndarray, mesh, k: int = 10,
                       query_batch: int = 1024, chunk: int = 8192) -> float:
     """Recall@k streaming the store through the sharded cross-shard merge —
     never materializes more than one store shard."""
-    _, retrieved = topk_over_store(
-        np.asarray(query_vecs, np.float32), store, mesh, k=k,
-        chunk=chunk, query_batch=query_batch)
-    hits = (retrieved == gold_ids[:, None]).any(axis=1).sum()
+    hits = hits_from_store(query_vecs, store, gold_ids, mesh, k=k,
+                           query_batch=query_batch, chunk=chunk)
     return float(hits) / max(query_vecs.shape[0], 1)
 
 
@@ -61,10 +71,22 @@ def evaluate_recall(embedder: BulkEmbedder, corpus: ToyCorpus,
                     store: VectorStore, num_queries: Optional[int] = None,
                     k: int = 10) -> Tuple[float, int]:
     """Embed eval queries, search the store, return (recall@k, num_queries).
-    Gold label for query i is page i (ToyCorpus invariant)."""
+    Gold label for query i is page i (ToyCorpus invariant).
+
+    Multi-host: each process embeds + searches a contiguous slice of the
+    query range on its (local) mesh — every host still streams the full
+    store, since any page can be a nearest neighbour of any query — and
+    only the integer hit counts cross processes (call stack §4.3)."""
+    from dnn_page_vectors_tpu.parallel.multihost import (
+        allgather_hosts, process_info)
     nq = min(num_queries or embedder.cfg.eval.eval_queries, corpus.num_pages)
+    pi, pc = process_info()
+    lo, hi = pi * nq // pc, (pi + 1) * nq // pc
     query_vecs = embedder.embed_texts(
-        [corpus.query_text(i) for i in range(nq)], tower="query")
-    gold = np.arange(nq, dtype=np.int64)
-    r = recall_from_store(query_vecs, store, gold, embedder.mesh, k=k)
-    return r, nq
+        [corpus.query_text(i) for i in range(lo, hi)], tower="query")
+    gold = np.arange(lo, hi, dtype=np.int64)
+    hits = hits_from_store(query_vecs, store, gold, embedder.mesh, k=k)
+    if pc > 1:
+        counts = allgather_hosts(np.array([hits, hi - lo], np.int64)).sum(0)
+        return float(counts[0]) / max(int(counts[1]), 1), nq
+    return float(hits) / max(nq, 1), nq

@@ -33,7 +33,14 @@ class BulkEmbedder:
         self.model = model
         # (re-)place params for THIS mesh — training may have run on a
         # different mesh shape than the embed job (call stack §4.2 restores
-        # from checkpoint anyway).
+        # from checkpoint anyway). Under multi-host, `mesh` is process-LOCAL
+        # (parallel/multihost.py) and params trained on the global mesh are
+        # pulled to host first (replicated DP params: every host has a copy).
+        if any(isinstance(x, jax.Array) and not x.is_fully_addressable
+               for x in jax.tree_util.tree_leaves(params)):
+            from dnn_page_vectors_tpu.parallel.multihost import (
+                host_replicated_copy)
+            params = host_replicated_copy(params)
         self.params = shard_params(params, mesh)
         self.page_tok = page_tok
         self.query_tok = query_tok
@@ -67,11 +74,18 @@ class BulkEmbedder:
             _encode_stack, in_shardings=(None, stk), out_shardings=stk)
 
     # -- single batches ---------------------------------------------------
+    def _put(self, ids: np.ndarray) -> jax.Array:
+        # jit under process_count>1 refuses numpy args with non-replicated
+        # in_shardings (it can't tell global from process-local values), so
+        # place the batch explicitly; the mesh here is fully addressable
+        # (local under multi-host, global single-process).
+        return jax.device_put(ids, batch_sharding(self.mesh))
+
     def embed_pages(self, ids: np.ndarray) -> np.ndarray:
-        return np.asarray(self._encode_page(self.params, ids))
+        return np.asarray(self._encode_page(self.params, self._put(ids)))
 
     def embed_queries(self, ids: np.ndarray) -> np.ndarray:
-        return np.asarray(self._encode_query(self.params, ids))
+        return np.asarray(self._encode_query(self.params, self._put(ids)))
 
     def embed_texts(self, texts, tower: str = "query",
                     batch_size: Optional[int] = None) -> np.ndarray:
@@ -96,29 +110,63 @@ class BulkEmbedder:
     # -- the bulk job -----------------------------------------------------
     def embed_corpus(self, corpus: ToyCorpus, store: VectorStore,
                      batch_size: Optional[int] = None, resume: bool = True,
-                     log: Optional[MetricsLogger] = None) -> VectorStore:
+                     log: Optional[MetricsLogger] = None,
+                     start: int = 0, stop: Optional[int] = None) -> VectorStore:
         """Sweep the corpus into the store, one store-shard at a time.
 
         Resume: completed shards are recorded in the store manifest and
         skipped on restart (SURVEY.md §5.3 fault recovery).
+
+        Multi-host (SURVEY.md §4.2 "each host reads its file shards"): when
+        jax.process_count() > 1, each process embeds only the store shards
+        with ``si % process_count == process_index`` on its process-LOCAL
+        mesh — the forward pass has no collectives, so hosts run fully
+        independently and a straggler never stalls the others — and records
+        them under its own writer manifest; after a barrier, process 0 folds
+        the writer manifests into the main one.
+
+        `start`/`stop` restrict the sweep to a page range (both must be
+        store-shard-aligned so resume bookkeeping stays per-shard exact);
+        this is the manual variant of the same sharding for fleets launched
+        WITHOUT jax.distributed — one process per corpus slice, each with
+        ``writer_id=start // shard_size`` (docs/SCALING.md recipe).
         """
         bs = batch_size or self.cfg.eval.embed_batch_size
         shard_size = store.manifest["shard_size"]
         assert shard_size % bs == 0 or shard_size >= corpus.num_pages, (
             "shard_size must be a batch multiple for resumable sweeps")
-        n_shards = -(-corpus.num_pages // shard_size)
+        stop = corpus.num_pages if stop is None else min(stop, corpus.num_pages)
+        if start % shard_size:
+            raise ValueError(f"start={start} must be a multiple of the store "
+                             f"shard_size {shard_size}")
+        if stop % shard_size and stop != corpus.num_pages:
+            raise ValueError(f"stop={stop} must be shard-aligned (multiple of "
+                             f"{shard_size}) or the corpus end "
+                             f"{corpus.num_pages}")
+        pi, pc = jax.process_index(), jax.process_count()
+        if pc > 1:
+            from dnn_page_vectors_tpu.parallel.multihost import is_local_mesh
+            if not is_local_mesh(self.mesh):
+                raise ValueError(
+                    "multi-process embed_corpus requires a process-local "
+                    "mesh (parallel.multihost.local_mesh): a global mesh "
+                    "would deadlock on per-process shard loops")
+            if store.writer_id != pi:
+                raise ValueError(
+                    f"multi-process embed_corpus needs "
+                    f"writer_id=process_index ({pi}), got {store.writer_id}")
         done = store.completed_shards() if resume else set()
         n_dev = self.mesh.devices.size
         t0 = time.perf_counter()
         pages = 0
-        for si in range(n_shards):
-            if si in done:
+        for si in range(start // shard_size, -(-stop // shard_size)):
+            if si in done or si % pc != pi:
                 continue
-            start = si * shard_size
-            stop = min(start + shard_size, corpus.num_pages)
+            lo = si * shard_size
+            hi = min(lo + shard_size, corpus.num_pages)
             ids_acc, vec_acc = [], []
             batches = iter_corpus_batches(corpus, self.page_tok, bs,
-                                          start=start, stop=stop)
+                                          start=lo, stop=hi)
             # Output is double-buffered (VERDICT r1 #8): dispatch batch i's
             # encode (async under JAX's deferred execution), THEN materialize
             # batch i-1's vectors — the device->host copy of the previous
@@ -143,4 +191,11 @@ class BulkEmbedder:
                 dt = time.perf_counter() - t0
                 log.write({"bulk_embed_shard": si,
                            "pages_per_sec_per_chip": pages / dt / n_dev})
+        if pc > 1:
+            from dnn_page_vectors_tpu.parallel.multihost import barrier
+            barrier("embed_corpus_written")
+            if pi == 0:
+                store.merge_writers()
+            barrier("embed_corpus_merged")
+            store.reload()
         return store

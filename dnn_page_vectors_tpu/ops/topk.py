@@ -184,8 +184,7 @@ def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
     if store.num_vectors == 0 or nq == 0:
         return best_s, best_i
     # one static shape for every disk shard -> a single compiled program
-    shard_rows = max((s["count"] for s in store.manifest["shards"]),
-                     default=0)
+    shard_rows = max((s["count"] for s in store.shards()), default=0)
     shard_rows += (-shard_rows) % max(n_data, 1)
     qb = min(query_batch, nq)
     for ids, vecs in store.iter_shards():

@@ -37,10 +37,13 @@ def multihost_init(coordinator: Optional[str] = None,
         jax.distributed.initialize()
 
 
-def make_mesh(cfg: MeshConfig) -> Mesh:
+def make_mesh(cfg: MeshConfig, devices: Optional[list] = None) -> Mesh:
     """('data', 'model', 'seq') mesh; size-1 axes cost nothing and keep every
-    PartitionSpec in the codebase valid on every mesh."""
-    devices = jax.devices()
+    PartitionSpec in the codebase valid on every mesh. `devices` defaults to
+    all devices (the SPMD training mesh); multihost.local_mesh passes
+    jax.local_devices() for the per-host inference meshes."""
+    if devices is None:
+        devices = jax.devices()
     need = cfg.num_devices
     if len(devices) < need:
         raise ValueError(

@@ -12,6 +12,7 @@ import re
 from typing import Any, List, Tuple
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # (path-regex, spec). First match wins. Paths look like
@@ -55,8 +56,22 @@ def param_shardings(params: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map_with_path(_one, params)
 
 
+def put_global(x: Any, sharding: NamedSharding) -> jax.Array:
+    """device_put that also works when `sharding` spans devices of OTHER
+    processes (multi-host training): each process supplies its addressable
+    shards from its local copy via make_array_from_callback. The host value
+    must be identical on every process (true for seeded init and restored
+    checkpoints — the only callers)."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def shard_params(params: Any, mesh: Mesh) -> Any:
-    return jax.device_put(params, param_shardings(params, mesh))
+    return jax.tree_util.tree_map(put_global, params,
+                                  param_shardings(params, mesh))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

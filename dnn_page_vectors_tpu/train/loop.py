@@ -30,7 +30,7 @@ from dnn_page_vectors_tpu.models.factory import build_two_tower
 from dnn_page_vectors_tpu.models.losses import cosine_contrastive_loss
 from dnn_page_vectors_tpu.parallel.mesh import fit_mesh_to_devices, make_mesh
 from dnn_page_vectors_tpu.parallel.sharding import (
-    batch_sharding, param_shardings, replicated, shard_params,
+    batch_sharding, param_shardings, put_global, replicated, shard_params,
     stacked_batch_sharding)
 from dnn_page_vectors_tpu.train.optimizer import make_optimizer
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
@@ -120,9 +120,9 @@ class Trainer:
             sh = getattr(leaf, "sharding", None)
             if sh is not None and frozenset(sh.device_set) == mesh_devs:
                 return leaf
-            return jax.device_put(leaf, replicated(self.mesh))
+            return put_global(leaf, replicated(self.mesh))
         opt_state = jax.tree_util.tree_map(_on_mesh, self.tx.init(params))
-        step = jax.device_put(jnp.zeros((), jnp.int32), replicated(self.mesh))
+        step = put_global(jnp.zeros((), jnp.int32), replicated(self.mesh))
         return TrainState(params=params, opt_state=opt_state, step=step)
 
     def _tok_extra(self) -> tuple:
@@ -132,10 +132,12 @@ class Trainer:
     def base_rng(self) -> jax.Array:
         """Replicated base key for the per-step dropout fold_in, built with
         train.dropout_rng (default rbg — see config.py for the measured
-        threefry cost this avoids)."""
+        threefry cost this avoids). Typed keys can't pass through numpy, so
+        the multi-process-safe placement goes via key_data/wrap_key_data."""
         key = jax.random.key(self.cfg.train.seed + 1,
                              impl=self.cfg.train.dropout_rng)
-        return jax.device_put(key, replicated(self.mesh))
+        data = put_global(jax.random.key_data(key), replicated(self.mesh))
+        return jax.random.wrap_key_data(data, impl=self.cfg.train.dropout_rng)
 
     # -- compiled step ----------------------------------------------------
     def compiled_step(self, state: TrainState):

