@@ -72,9 +72,16 @@ class Attention(nn.Module):
                  rel_bias: jnp.ndarray | None,
                  rel_bias_table: jnp.ndarray | None = None) -> jnp.ndarray:
         head_dim = self.model_dim // self.num_heads
+        B, L, _ = x.shape
+        # Three separate projections, DELIBERATELY not fused into one [d,3d]
+        # dot: measured on v5e (round 4), the fused dot wins 2.7x in
+        # isolation (x read once, wider N) but LOSES 2-10% inside the full
+        # model — the post-matmul q/k/v slices materialize three [B,L,H,Dh]
+        # copies and XLA already overlaps the separate dots with neighboring
+        # work. Interleaved A/B at bench shapes: fused 59.8/15.7 ms
+        # (train/embed), separate 59.0/14.1 ms. See docs/MFU.md.
         dense = lambda name: nn.Dense(self.model_dim, use_bias=self.use_bias,
                                       dtype=self.dtype, name=name)
-        B, L, _ = x.shape
         shape = (B, L, self.num_heads, head_dim)
         q = dense("wq")(x).reshape(shape)
         k = dense("wk")(x).reshape(shape)
@@ -136,6 +143,10 @@ class Block(nn.Module):
 
         h = norm("ln_mlp")(x)
         if self.variant == "t5":  # gated GELU, no biases (mT5 geometry)
+            # separate gate/value dots, DELIBERATELY not fused into one
+            # [d, 2*mlp] projection: measured at mT5-base geometry on v5e
+            # (round 4), the fused variant's post-matmul de-interleave made
+            # the forward 34% slower (62.7 vs 46.8 ms). See docs/MFU.md.
             wi0 = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
                            name="wi_0")(h)
             wi1 = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
