@@ -74,13 +74,19 @@ class Trainer:
     """Wires config -> data -> model -> mesh -> compiled step (§4.1)."""
 
     def __init__(self, cfg: Config, corpus: Optional[ToyCorpus] = None,
-                 hard_negative_lookup=None, workdir: Optional[str] = None):
+                 hard_negative_lookup=None, workdir: Optional[str] = None,
+                 tokenizers: Optional[Tuple[Any, Any]] = None):
+        """`tokenizers=(query_tok, page_tok)` bypasses build_tokenizer —
+        anything with .vocab_size and .encode_batch works. Used by bench.py
+        to drive true-vocab-size embedding tables with synthetic ids
+        (training a 250k SentencePiece is data prep, not step cost)."""
         self.cfg = cfg
         self.workdir = workdir or cfg.workdir
         os.makedirs(self.workdir, exist_ok=True)
         self.corpus = corpus if corpus is not None else build_corpus(cfg)
-        self.query_tok, self.page_tok = build_tokenizer(
-            cfg, self.corpus, cache_dir=self.workdir)
+        self.query_tok, self.page_tok = (
+            tokenizers if tokenizers is not None
+            else build_tokenizer(cfg, self.corpus, cache_dir=self.workdir))
         fitted = fit_mesh_to_devices(cfg.mesh)
         want = (cfg.mesh.data, cfg.mesh.model, cfg.mesh.seq)
         got = (fitted.data, fitted.model, fitted.seq)
