@@ -342,9 +342,66 @@ def run_worker() -> None:
                                if peak else None),
             "long_page_len": lcfg.data.page_len,
         })
+        del lstate, lstep, lbatches     # free HBM for the t5 variant
+
+        # t5 long-context variant (round 4): the Pallas dbias backward
+        # keeps the T5-biased flash path O(L) in training too, so long
+        # multilingual pages get their first perf datapoint. Own
+        # try/except + error key: a crash here keeps the bert-long numbers
+        # above and is distinguishable from a bert-long failure.
+        try:
+            _long_t5(rec, n_dev, peak, lsteps, reps, _best_time, _stamp)
+        except Exception as e:
+            rec["long_t5_error"] = f"{type(e).__name__}: {e}"[:300]
     except Exception as e:  # optional sweep must never cost the round
         rec["long_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(rec), flush=True)
+
+
+def _long_t5(rec, n_dev, peak, lsteps, reps, _best_time, _stamp) -> None:
+    import os
+
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.train.loop import Trainer
+    from dnn_page_vectors_tpu.utils.flops import train_flops_per_pair
+    from dnn_page_vectors_tpu.utils.platform import hard_sync
+
+    _stamp("building long-context t5 variant (flash + rel bias)")
+    tcfg = get_config("bert_long_sp", {
+        "data.num_pages": 2_048,
+        "data.vocab_size": 8_192,
+        "model.encoder": "t5",
+        "model.attention": "flash",
+        "train.batch_size": int(os.environ.get("BENCH_LONG_BATCH", "64")),
+        "train.log_every": 1_000_000,
+        "mesh.data": n_dev, "mesh.seq": 1,
+    })
+    ttrainer = Trainer(tcfg,
+                       workdir="/tmp/dnn_page_vectors_tpu_bench_long_t5")
+    tstate = ttrainer.init_state()
+    tstep = ttrainer.compiled_step(tstate)
+    tit = iter(ttrainer.batches())
+    tbatches = [next(tit) for _ in range(2)]
+    trng = ttrainer.base_rng()
+    for i in range(2):
+        tstate, tm = tstep(tstate, tbatches[i % 2], trng)
+    hard_sync(tm)
+    _stamp("long-context t5 step compiled; timing")
+
+    def _long_t5_loop():
+        nonlocal tstate
+        for i in range(lsteps):
+            tstate, tm = tstep(tstate, tbatches[i % 2], trng)
+        return tm
+
+    tdt = _best_time(_long_t5_loop, reps)
+    tpps = tcfg.train.batch_size * lsteps / tdt / n_dev
+    tflops = train_flops_per_pair(tcfg, tcfg.train.batch_size)
+    rec.update({
+        "long_t5_train_pages_per_sec_per_chip": round(tpps, 2),
+        "long_t5_train_mfu": (round(tpps * tflops / peak, 4)
+                              if peak else None),
+    })
 
 
 # ---------------------------------------------------------------------------

@@ -54,9 +54,9 @@ class ModelConfig:
     model_dim: int = 256
     dropout: float = 0.1
     # dense | flash | ring. flash = Pallas kernel, O(L) HBM in forward AND
-    # backward for bert; the t5 variant's relative-position bias keeps a
-    # reference backward that re-materialises [B,H,L,S] when TRAINING (fine
-    # for short t5 pages; long-page training belongs to bert+flash/ring).
+    # backward for BOTH variants: the t5 relative-position bias has its own
+    # Pallas dbias kernel (batch-innermost accumulating grid), so biased
+    # training never materialises [B,H,L,S] either (round 4).
     attention: str = "dense"
     shared_towers: bool = False      # share params between query/page towers
     dtype: str = "bfloat16"          # compute dtype on MXU

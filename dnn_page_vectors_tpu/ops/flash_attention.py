@@ -14,16 +14,15 @@ sequence-parallel path (parallel/ring_attention.py) shards S over the mesh
 'seq' axis, keeping each per-chip slice inside this kernel's bound. Matmuls
 run on the MXU with f32 accumulation per /opt/skills/guides/pallas_guide.md.
 
-Autodiff (VERDICT r1 #7): the backward is ALSO Pallas — two kernels that
+Autodiff (VERDICT r1 #7): the backward is ALSO Pallas — kernels that
 recompute attention probabilities per block from the saved log-sum-exp
-(one for dq gridded over Q blocks, one for dk/dv gridded over KV blocks),
-so long-page TRAINING keeps the flash memory shape too; no [B, H, L, S]
-tensor exists in forward or backward. Exception: with a T5 relative-
-position `bias` the backward falls back to differentiating the XLA
-reference (dbias needs a cross-batch reduction the sequential-grid kernel
-layout doesn't cover yet); that path re-materialises [B, H, L, S] during
-training and model.attention='flash' documents the caveat — T5 pages are
-short (config 5), the long-page SP family is BERT.
+(dq gridded over Q blocks, dk/dv gridded over KV blocks), so long-page
+TRAINING keeps the flash memory shape too; no [B, H, L, S] tensor exists
+in forward or backward. With a T5 relative-position `bias`, a third
+kernel accumulates dbias[h,l,s] = sum_b ds[b,h,l,s] across a
+batch-innermost sequential grid (VERDICT r3 Missing #3), so the biased
+path also never materialises [B, H, L, S] — dbias itself is [H, L, S],
+the same footprint as the bias input.
 
 On CPU (tests, fake meshes) the kernels run in interpret mode automatically.
 """
@@ -106,11 +105,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, out_ref, lse_ref):
     lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, lse_ref.shape[3]))
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref, lse_ref,
-                     delta_ref, dq_ref):
-    # Grid (B, H, Lp/BQ). Per program: one Q block vs the full KV slice,
-    # recomputing p from the saved lse (no [B,H,L,S] in HBM).
-    # lse_ref/delta_ref: [1,1,BQ,LANE] lane-broadcast (see _LSE_LANES).
+def _block_ds(q_ref, k_ref, v_ref, mask_ref, bias_ref, g_ref, lse_ref,
+              delta_ref):
+    """Recompute ds = p * (dp - delta) for one Q block against the full KV
+    slice from the saved lse (no [B,H,L,S] in HBM). Shared by the dq and
+    dbias kernels; returns (ds [BQ,S], k [S,Dh]) in float32.
+    lse_ref/delta_ref: [1,1,BQ,LANE] lane-broadcast (see _LSE_LANES)."""
     dh = q_ref.shape[3]
     scale = 1.0 / np.sqrt(dh)
 
@@ -125,19 +125,57 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref, lse_ref,
     s = scale * jax.lax.dot_general(                          # [BQ, S]
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
+    if bias_ref is not None:
+        s = s + bias_ref[0]
     s = jnp.where(mask > 0, s, _NEG_INF)
     p = jnp.exp(s - lse)                                      # [BQ, S]
     dp = jax.lax.dot_general(                                 # g @ v^T
         g, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)                                     # [BQ, S]
+    return p * (dp - delta), k                                # ds, k
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref,
+                     lse_ref, delta_ref, dq_ref):
+    # Unbiased path. Grid (B, H, Lp/BQ): one Q block vs the full KV slice.
+    dh = q_ref.shape[3]
+    scale = 1.0 / np.sqrt(dh)
+    ds, k = _block_ds(q_ref, k_ref, v_ref, mask_ref, None, g_ref,
+                      lse_ref, delta_ref)
     dq_ref[0, 0] = scale * jax.lax.dot_general(               # ds @ k
         ds, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref, lse_ref,
-                      delta_ref, dk_ref, dv_ref):
+def _flash_dq_dbias_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, g_ref,
+                           lse_ref, delta_ref, dq_ref, db_ref):
+    # Biased path: ONE pass produces both dq and dbias from the same ds.
+    # Grid (H, Lp/BQ, B) with the BATCH dim INNERMOST: dq's index map uses
+    # all three dims, while db's drops b — consecutive grid steps revisit
+    # the same [1, BQ, Sp] db block, and TPU grids run sequentially, so
+    # `db += ds` accumulates the cross-batch reduction dbias[h,l,s] =
+    # sum_b ds[b,h,l,s] without any [B,H,L,S] tensor — the piece the old
+    # reference-VJP fallback re-materialised (VERDICT r3 Missing #3).
+    dh = q_ref.shape[3]
+    scale = 1.0 / np.sqrt(dh)
+    ds, k = _block_ds(q_ref, k_ref, v_ref, mask_ref, bias_ref, g_ref,
+                      lse_ref, delta_ref)
+    dq_ref[0, 0] = scale * jax.lax.dot_general(               # ds @ k
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    b = pl.program_id(2)
+
+    @pl.when(b == 0)
+    def _init():
+        db_ref[0] = ds
+
+    @pl.when(b > 0)
+    def _acc():
+        db_ref[0] += ds
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, g_ref,
+                      lse_ref, delta_ref, dk_ref, dv_ref):
     # Grid (B, H, Sp/BKV). Per program: one KV block vs the full Q slice.
     dh = k_ref.shape[3]
     scale = 1.0 / np.sqrt(dh)
@@ -153,6 +191,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, g_ref, lse_ref,
     s = scale * jax.lax.dot_general(                          # [L, BKV]
         q, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
+    if bias_ref is not None:
+        s = s + bias_ref[0]
     s = jnp.where(mask > 0, s, _NEG_INF)
     p = jnp.exp(s - lse)                                      # [L, BKV]
     dv_ref[0, 0] = jax.lax.dot_general(                       # p^T @ g
@@ -194,6 +234,18 @@ def _pad_inputs(q, k, v, kv_mask, bias, block_q, block_kv):
     return q, k, v, kv_mask, bias, block_q, block_kv, L, S
 
 
+# Single-device KV bound: each grid program holds the full [Sp, Dh] K/V
+# slice plus a [block_q, Sp] f32 score tile in VMEM (~16 MB on v5e). Beyond
+# this, Mosaic fails with an opaque allocation error, so raise a directed
+# one instead (ADVICE r3). The BIASED path additionally holds [block_q, Sp]
+# bias and (in backward) the revisited dbias output block — roughly 3x the
+# per-program tile budget — so its bound is halved. The over-bound path is
+# ring-attention sequence parallelism (parallel/ring_attention.py), which
+# keeps each per-chip KV slice inside these bounds.
+_MAX_KV_TOKENS = 8_192
+_MAX_KV_TOKENS_BIASED = 4_096
+
+
 def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
     """Returns (out [B,H,L,Dh] f32, lse [B,H,L] f32)."""
     if interpret is None:  # compiled on TPU, interpreted elsewhere
@@ -202,6 +254,15 @@ def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
         q, k, v, kv_mask, bias, block_q, block_kv)
     B, H, Lp, Dh = q.shape
     Sp = k.shape[2]
+    limit = _MAX_KV_TOKENS if bias is None else _MAX_KV_TOKENS_BIASED
+    if not interpret and Sp > limit:
+        raise ValueError(
+            f"flash_attention: KV length {Sp} exceeds the single-device "
+            f"VMEM bound (~{limit} tokens{' with bias' if bias is not None else ''}): "
+            "the [block_q, S] score tile + full KV slice must fit VMEM. "
+            "Shard the sequence over the mesh 'seq' axis instead "
+            "(model.attention='ring', parallel/ring_attention.py), which "
+            "keeps each per-chip KV slice inside this kernel's bound.")
 
     mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]         # [B, 1, S]
 
@@ -244,14 +305,16 @@ def _flash_forward(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
     return out[:, :, :L], lse[:, :, :L, 0]
 
 
-def _flash_backward(q, k, v, kv_mask, g, out, lse, block_q, block_kv,
+def _flash_backward(q, k, v, kv_mask, bias, g, out, lse, block_q, block_kv,
                     interpret):
-    """Pallas dq/dk/dv with per-block recompute from the saved lse."""
+    """Pallas dq/dk/dv (+ dbias when `bias` is given) with per-block
+    recompute from the saved lse. Returns (dq, dk, dv, db-or-None)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     in_dtypes = (q.dtype, k.dtype, v.dtype)
-    (q, k, v, kv_mask, _, block_q, block_kv, L, S) = _pad_inputs(
-        q, k, v, kv_mask, None, block_q, block_kv)
+    bias_dtype = None if bias is None else bias.dtype
+    (q, k, v, kv_mask, bias, block_q, block_kv, L, S) = _pad_inputs(
+        q, k, v, kv_mask, bias, block_q, block_kv)
     B, H, Lp, Dh = q.shape
     Sp = k.shape[2]
     pad_l = Lp - L
@@ -266,43 +329,81 @@ def _flash_backward(q, k, v, kv_mask, g, out, lse, block_q, block_kv,
     # lane-broadcast the row vectors into Mosaic-lowerable layout
     lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LSE_LANES,))
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LSE_LANES,))
+    bias_f = None if bias is None else bias.astype(jnp.float32)
 
-    qspec = pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0))
-    kfull = pl.BlockSpec((1, 1, Sp, Dh), lambda b, h, i: (b, h, 0, 0))
-    rowspec = pl.BlockSpec((1, 1, block_q, _LSE_LANES),
-                           lambda b, h, i: (b, h, i, 0))
-
-    dq = pl.pallas_call(
-        _flash_dq_kernel,
-        grid=(B, H, Lp // block_q),
-        in_specs=[qspec, kfull, kfull,
-                  pl.BlockSpec((1, 1, Sp), lambda b, h, i: (b, 0, 0)),
-                  qspec, rowspec, rowspec],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Lp, Dh), jnp.float32),
-        interpret=interpret,
-    )(q, k, v, mask_i32, g, lse, delta)
+    db = None
+    if bias is None:
+        qspec = pl.BlockSpec((1, 1, block_q, Dh),
+                             lambda b, h, i: (b, h, i, 0))
+        kfull = pl.BlockSpec((1, 1, Sp, Dh), lambda b, h, i: (b, h, 0, 0))
+        rowspec = pl.BlockSpec((1, 1, block_q, _LSE_LANES),
+                               lambda b, h, i: (b, h, i, 0))
+        dq = pl.pallas_call(
+            _flash_dq_kernel,
+            grid=(B, H, Lp // block_q),
+            in_specs=[qspec, kfull, kfull,
+                      pl.BlockSpec((1, 1, Sp), lambda b, h, i: (b, 0, 0)),
+                      qspec, rowspec, rowspec],
+            out_specs=qspec,
+            out_shape=jax.ShapeDtypeStruct((B, H, Lp, Dh), jnp.float32),
+            interpret=interpret,
+        )(q, k, v, mask_i32, g, lse, delta)
+    else:
+        # biased: ONE fused pass for dq + dbias, grid (H, Q-blocks, B) with
+        # b innermost (see _flash_dq_dbias_kernel)
+        qspec = pl.BlockSpec((1, 1, block_q, Dh),
+                             lambda h, i, b: (b, h, i, 0))
+        kfull = pl.BlockSpec((1, 1, Sp, Dh), lambda h, i, b: (b, h, 0, 0))
+        rowspec = pl.BlockSpec((1, 1, block_q, _LSE_LANES),
+                               lambda h, i, b: (b, h, i, 0))
+        dq, db = pl.pallas_call(
+            _flash_dq_dbias_kernel,
+            grid=(H, Lp // block_q, B),
+            in_specs=[qspec, kfull, kfull,
+                      pl.BlockSpec((1, 1, Sp), lambda h, i, b: (b, 0, 0)),
+                      pl.BlockSpec((1, block_q, Sp),
+                                   lambda h, i, b: (h, i, 0)),
+                      qspec, rowspec, rowspec],
+            out_specs=[qspec,
+                       pl.BlockSpec((1, block_q, Sp),
+                                    lambda h, i, b: (h, i, 0))],
+            out_shape=[jax.ShapeDtypeStruct((B, H, Lp, Dh), jnp.float32),
+                       jax.ShapeDtypeStruct((H, Lp, Sp), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, mask_i32, bias_f, g, lse, delta)
+        db = db[:, :L, :S].astype(bias_dtype)
 
     kvspec = pl.BlockSpec((1, 1, block_kv, Dh), lambda b, h, j: (b, h, j, 0))
     qfull = pl.BlockSpec((1, 1, Lp, Dh), lambda b, h, j: (b, h, 0, 0))
     rowfull = pl.BlockSpec((1, 1, Lp, _LSE_LANES),
                            lambda b, h, j: (b, h, 0, 0))
+
+    def dkv_kernel(*refs):
+        if bias is None:
+            refs = refs[:4] + (None,) + refs[4:]
+        _flash_dkv_kernel(*refs)
+
+    in_specs = [qfull, kvspec, kvspec,
+                pl.BlockSpec((1, 1, block_kv), lambda b, h, j: (b, 0, j))]
+    args = [q, k, v, mask_i32]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, Lp, block_kv), lambda b, h, j: (h, 0, j)))
+        args.append(bias_f)
     dk, dv = pl.pallas_call(
-        _flash_dkv_kernel,
+        dkv_kernel,
         grid=(B, H, Sp // block_kv),
-        in_specs=[qfull, kvspec, kvspec,
-                  pl.BlockSpec((1, 1, block_kv), lambda b, h, j: (b, 0, j)),
-                  qfull, rowfull, rowfull],
+        in_specs=in_specs + [qfull, rowfull, rowfull],
         out_specs=[kvspec, kvspec],
         out_shape=[jax.ShapeDtypeStruct((B, H, Sp, Dh), jnp.float32),
                    jax.ShapeDtypeStruct((B, H, Sp, Dh), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, mask_i32, g, lse, delta)
+    )(*args, g, lse, delta)
 
     dq = dq[:, :, :L].astype(in_dtypes[0])
     dk = dk[:, :, :S].astype(in_dtypes[1])
     dv = dv[:, :, :S].astype(in_dtypes[2])
-    return dq, dk, dv
+    return dq, dk, dv, db
 
 
 def _fwd(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
@@ -313,17 +414,8 @@ def _fwd(q, k, v, kv_mask, bias, block_q, block_kv, interpret):
 
 def _bwd(block_q, block_kv, interpret, res, g):
     q, k, v, kv_mask, bias, out, lse = res
-    if bias is None:
-        dq, dk, dv = _flash_backward(q, k, v, kv_mask, g, out, lse,
+    dq, dk, dv, db = _flash_backward(q, k, v, kv_mask, bias, g, out, lse,
                                      block_q, block_kv, interpret)
-        return dq, dk, dv, None, None
-    # T5 bias path: dbias needs a cross-batch reduction; fall back to
-    # differentiating the reference (re-materialises [B,H,L,S] — see
-    # module docstring caveat; T5 pages are short).
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_, b_: reference_attention(q_, k_, v_, kv_mask, b_),
-        q, k, v, bias)
-    dq, dk, dv, db = vjp(g)
     return dq, dk, dv, None, db
 
 
