@@ -126,8 +126,18 @@ _POOL_LOCK = threading.Lock()
 def _threaded_encode(native, texts: Sequence[str], max_tokens: int,
                      k: int) -> np.ndarray:
     """Chunk the batch over a shared thread pool. Correct because chunks are
-    independent and the C ABI call drops the GIL for its whole duration."""
+    independent and the C ABI call drops the GIL for its whole duration.
+
+    The lock covers BOTH pool replacement and task submission (ADVICE r3):
+    Executor.map submits every future eagerly at call time, so submitting
+    under the lock means no thread can observe a pool that another thread
+    is about to shut down ('cannot schedule new futures after shutdown' —
+    which encode_batch's fallback would silently turn into a ~6x slower
+    pure-Python re-encode). shutdown(wait=False) never cancels futures
+    already submitted, so results are consumed safely outside the lock."""
     global _POOL, _POOL_SIZE
+    n = len(texts)
+    bounds = [(i * n // k, (i + 1) * n // k) for i in range(k)]
     with _POOL_LOCK:  # prefetch producers may race first use / growth
         if _POOL is None or _POOL_SIZE < k:
             import concurrent.futures
@@ -135,11 +145,10 @@ def _threaded_encode(native, texts: Sequence[str], max_tokens: int,
                 _POOL.shutdown(wait=False)
             _POOL = concurrent.futures.ThreadPoolExecutor(max_workers=k)
             _POOL_SIZE = k
-    n = len(texts)
-    bounds = [(i * n // k, (i + 1) * n // k) for i in range(k)]
-    parts = _POOL.map(
-        lambda se: native.encode_batch(texts[se[0]:se[1]], max_tokens, UNK_ID),
-        bounds)
+        parts = _POOL.map(
+            lambda se: native.encode_batch(texts[se[0]:se[1]], max_tokens,
+                                           UNK_ID),
+            bounds)
     return np.concatenate(list(parts), axis=0)
 
 
@@ -267,8 +276,18 @@ class SubwordTokenizer:
                 if k > 1:
                     return _threaded_encode(native, texts, self.max_tokens, k)
                 return native.encode_batch(texts, self.max_tokens, UNK_ID)
-            except Exception:
-                pass  # fallback contract: never crash where Python works
+            except Exception as e:
+                # fallback contract: never crash where Python works — but a
+                # silent fallback hides a ~6x host-throughput loss, so warn
+                # ONCE per process (ADVICE r3)
+                if not getattr(SubwordTokenizer, "_warned_fallback", False):
+                    SubwordTokenizer._warned_fallback = True
+                    import sys
+                    print(f"WARNING: native batch encode failed "
+                          f"({type(e).__name__}: {e}); falling back to "
+                          "pure-Python encoding (~6x slower host "
+                          "tokenization) — further falls are silent",
+                          file=sys.stderr)
         return np.stack([self.encode(t) for t in texts])
 
     def tokens(self, text: str) -> List[str]:

@@ -226,10 +226,16 @@ class Trainer:
         state = self.init_state() if state is None else state
         scan_k = max(1, cfg.train.scan_steps)
         if scan_k > 1:
-            checks = [("log_every", cfg.train.log_every)]
-            if ckpt_manager is not None:   # only enforced when it can fire
-                checks.append(("checkpoint_every", cfg.train.checkpoint_every))
-            for name, every in checks:
+            # Fused-dispatch alignment is validated up front, BEFORE any
+            # step runs and regardless of whether a ckpt_manager is passed
+            # (ADVICE r3: a run launched without a manager used to hit the
+            # checkpoint_every error only when it later resumed with one).
+            # Deliberately NOT in __init__: inference commands construct a
+            # Trainer for its model/tokenizers and must not fail on
+            # train-only settings.
+            for name, every in (("log_every", cfg.train.log_every),
+                                ("checkpoint_every",
+                                 cfg.train.checkpoint_every)):
                 if every % scan_k:
                     raise ValueError(
                         f"train.{name}={every} must be a multiple of "

@@ -3,6 +3,7 @@ mid-run checkpointing, restore equivalence (params AND data order), and the
 fault-injection bulk-embed resume test.
 """
 import dataclasses
+import pytest
 
 import jax
 import numpy as np
@@ -36,6 +37,7 @@ def _params_flat(state):
         jax.tree_util.tree_map(np.asarray, state.params))
 
 
+@pytest.mark.slow
 def test_resume_equals_uninterrupted(tmp_path):
     """train 6 == train 3 + restore + train 3, params AND data order."""
     cfg = _cfg()

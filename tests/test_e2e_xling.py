@@ -13,6 +13,7 @@ the CPU run stays in test budget; convergence at this scale was established
 by the round-3 experiment run (recall@10 = 1.0 at 300 steps).
 """
 import numpy as np
+import pytest
 
 from dnn_page_vectors_tpu.config import get_config
 from dnn_page_vectors_tpu.evals.recall import evaluate_recall
@@ -21,6 +22,7 @@ from dnn_page_vectors_tpu.infer.vector_store import VectorStore
 from dnn_page_vectors_tpu.train.loop import Trainer
 
 
+@pytest.mark.slow
 def test_mt5_cross_lingual_end_to_end(tmp_path):
     cfg = get_config("mt5_multilingual", {
         "data.num_pages": 600,

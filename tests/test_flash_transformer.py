@@ -10,6 +10,7 @@ from dnn_page_vectors_tpu.models.factory import build_two_tower
 
 
 @pytest.mark.parametrize("encoder", ["bert", "t5"])
+@pytest.mark.slow
 def test_flash_transformer_matches_dense(encoder):
     name = {"bert": "bert_mini_v5p16", "t5": "mt5_multilingual"}[encoder]
     base = {

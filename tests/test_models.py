@@ -8,15 +8,21 @@ from dnn_page_vectors_tpu.config import get_config
 from dnn_page_vectors_tpu.models.factory import build_two_tower
 from dnn_page_vectors_tpu.models.losses import cosine_contrastive_loss, l2_normalize
 
+# cdssm stays in the fast subset (one encoder covers the harness); the
+# rest are ~15-25 s each of CPU compile and run under -m slow
 CASES = [
     ("cdssm_toy", {}),
-    ("kim_cnn_v5e8", {}),
-    ("lstm_words", {"model.model_dim": 64, "model.embed_dim": 64,
-                    "model.num_layers": 2, "model.out_dim": 32}),
-    ("bert_mini_v5p16", {}),
-    ("mt5_multilingual", {"model.num_layers": 2, "model.model_dim": 64,
-                          "model.num_heads": 2, "model.mlp_dim": 128,
-                          "model.out_dim": 32}),
+    pytest.param("kim_cnn_v5e8", {}, marks=pytest.mark.slow),
+    pytest.param("lstm_words",
+                 {"model.model_dim": 64, "model.embed_dim": 64,
+                  "model.num_layers": 2, "model.out_dim": 32},
+                 marks=pytest.mark.slow),
+    pytest.param("bert_mini_v5p16", {}, marks=pytest.mark.slow),
+    pytest.param("mt5_multilingual",
+                 {"model.num_layers": 2, "model.model_dim": 64,
+                  "model.num_heads": 2, "model.mlp_dim": 128,
+                  "model.out_dim": 32},
+                 marks=pytest.mark.slow),
 ]
 
 

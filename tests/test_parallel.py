@@ -58,6 +58,7 @@ def test_dp_training_equals_single_device(tmp_path, eight_devices):
     np.testing.assert_allclose(m1["loss"], m8["loss"], rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_tp_dp_training_equals_single_device(tmp_path, eight_devices):
     # SGD for the equality check: adam divides by sqrt(v), which on
     # zero-gradient params amplifies cross-mesh reduction-order noise to
@@ -115,6 +116,7 @@ def test_sharded_bulk_embed_equals_single_device(tmp_path, eight_devices):
 
 
 @pytest.mark.parametrize("encoder", ["bert", "t5"])
+@pytest.mark.slow
 def test_ring_sp_training_equals_dense(tmp_path, eight_devices, encoder):
     """Full train steps with ring attention on a (data=2, seq=4) mesh match
     dense attention on a single device — sequence parallelism is exact

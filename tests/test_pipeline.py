@@ -19,6 +19,8 @@ statistical.
 """
 import os
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -41,6 +43,7 @@ def _eval(cfg, trainer, state, wd, tag):
     return r, emb, store
 
 
+@pytest.mark.slow
 def test_hard_negatives_beat_in_batch_only(tmp_path):
     # Hard regime: 40 near-duplicate pages per topic and queries that are
     # mostly topic words, so within-topic discrimination is the whole task
@@ -90,6 +93,7 @@ def test_hard_negatives_beat_in_batch_only(tmp_path):
         f"({r_in_batch}) from the same snapshot + step budget")
 
 
+@pytest.mark.slow
 def test_run_pipeline_end_to_end(tmp_path):
     # Easy regime so two short rounds converge: the point here is the
     # orchestration (round alternation, store regeneration, table refresh),

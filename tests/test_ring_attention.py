@@ -35,6 +35,7 @@ def test_ring_matches_reference(mesh_cfg, eight_devices):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match_reference(eight_devices):
     mesh = make_mesh(MeshConfig(1, 1, 8))
     q, k, v, mask = _mk(B=2, L=32, pad_tail=5)
@@ -50,6 +51,7 @@ def test_ring_gradients_match_reference(eight_devices):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_t5_bias_matches_reference(eight_devices):
     # T5 relative-position bias across the ring: each step rebuilds its
     # bias block from global positions; must equal the dense reference with

@@ -44,8 +44,23 @@ def test_scan_steps_matches_per_step(tmp_path):
 
 
 def test_scan_steps_rejects_misaligned_boundaries(tmp_path):
+    # misalignment surfaces BEFORE any step runs, with or without a ckpt
+    # manager (ADVICE r3) — but NOT at construction, which inference
+    # commands use for the model/tokenizers only
     cfg = get_config("cdssm_toy", dict(_OV, **{
-        "train.scan_steps": 5}))        # 12 % 5 != 0
+        "train.scan_steps": 5}))        # log_every 12 % 5 != 0
     t = Trainer(cfg, workdir=str(tmp_path))
     with pytest.raises(ValueError, match="multiple of"):
         t.train()
+    # checkpoint_every misalignment raises even with NO ckpt_manager passed
+    cfg = get_config("cdssm_toy", dict(_OV, **{
+        "train.scan_steps": 4, "train.checkpoint_every": 6}))
+    t = Trainer(cfg, workdir=str(tmp_path / "b"))
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        t.train()
+    # aligned log/checkpoint but a misaligned per-call step count
+    cfg = get_config("cdssm_toy", dict(_OV, **{
+        "train.scan_steps": 4, "train.checkpoint_every": 4}))
+    t = Trainer(cfg, workdir=str(tmp_path / "c"))
+    with pytest.raises(ValueError, match="multiple of"):
+        t.train(steps=7)

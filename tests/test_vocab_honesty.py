@@ -30,11 +30,13 @@ def test_config1_cdssm_trigram_buckets():
     assert p.vocab_size == cfg.data.trigram_buckets + 1  # +1: pad row 0
 
 
+@pytest.mark.slow
 def test_config2_kim_cnn_true_100k_word_vocab():
     cfg, q, p = _built_vocab("kim_cnn_v5e8", {"data.num_pages": 200_000})
     assert p.vocab_size == cfg.data.vocab_size == 100_000
 
 
+@pytest.mark.slow
 def test_config3_bert_true_30522_vocab():
     cfg, q, p = _built_vocab("bert_mini_v5p16", {"data.num_pages": 100_000})
     assert p.vocab_size == cfg.data.vocab_size == 30_522
@@ -52,6 +54,7 @@ def test_config4_hardneg_same_claim_as_config3():
     assert c4.data.vocab_size == c3.data.vocab_size
 
 
+@pytest.mark.slow
 def test_config5_mt5_true_250112_vocab():
     cfg, q, p = _built_vocab("mt5_multilingual",
                              {"data.num_pages": 300_000})
