@@ -176,11 +176,24 @@ def run_worker() -> None:
         page_stack = batches[0]["page"]          # [K, B, L] already stacked
         encode = embedder._encode_page_stack
         per_iter = batch * scan_k
+        embed_iters = max(1, embed_iters // scan_k)
     else:
-        page_stack = batches[0]["page"]
-        encode = embedder._encode_page
-        per_iter = batch
-    embed_iters = max(1, embed_iters // scan_k)
+        # measure the PRODUCTION embed path: eval.embed_stack batches fused
+        # per dispatch, exactly what embed_corpus runs (round 4 default 8)
+        import numpy as _np
+
+        from dnn_page_vectors_tpu.parallel.sharding import (
+            stacked_batch_sharding)
+        E = max(1, cfg.eval.embed_stack)
+        # device-resident BEFORE timing: a numpy arg would re-pay the H2D
+        # copy every timed iteration and understate the device metric
+        page_stack = jax.device_put(
+            _np.stack([_np.asarray(batches[i % len(batches)]["page"])
+                       for i in range(E)]),
+            stacked_batch_sharding(trainer.mesh))
+        encode = embedder._encode_page_stack
+        per_iter = batch * E
+        embed_iters = max(1, embed_iters // E)
     out = encode(embedder.params, page_stack)
     hard_sync(out)
 
