@@ -168,6 +168,23 @@ def merge_topk_host(best_s: np.ndarray, best_i: np.ndarray,
             np.take_along_axis(cat_i, pos, axis=1))
 
 
+def merge_shard_topk(q: jnp.ndarray, pages, page_ids: np.ndarray, valid: int,
+                     mesh: Mesh, k: int, best_s: np.ndarray,
+                     best_i: np.ndarray, chunk: int = 8192
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Fold ONE device-resident shard's top-k into the running host merge:
+    sharded_topk over `pages` (rows >= valid are padding), row indices
+    mapped through `page_ids`, -inf masking, merge. Shared by the streaming
+    path below and the HBM-resident serving path (infer/serve.py) so the
+    clip/mask edge cases live in exactly one place."""
+    sc, idx = sharded_topk(q, pages, mesh, k=k, chunk=chunk, valid=valid)
+    sc, idx = np.asarray(sc), np.asarray(idx)
+    pids = np.where(
+        idx >= 0, page_ids[np.clip(idx, 0, max(valid - 1, 0))], -1)
+    return merge_topk_host(best_s, best_i,
+                           np.where(np.isfinite(sc), sc, -np.inf), pids)
+
+
 def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
                     chunk: int = 8192, query_batch: int = 1024
                     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -195,15 +212,18 @@ def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
         ids = np.asarray(ids, np.int64)
         for s in range(0, nq, qb):
             q = query_vecs[s: s + qb]
-            if q.shape[0] < qb:                      # pad to compiled shape
+            pad_q = qb - q.shape[0]
+            if pad_q:                                # pad to compiled shape
                 q = np.concatenate(
-                    [q, np.zeros((qb - q.shape[0], dim), q.dtype)])
-            sc, idx = sharded_topk(jnp.asarray(q, jnp.float32), pages, mesh,
-                                   k=k, chunk=chunk, valid=n)
-            sc = np.asarray(sc)[: min(qb, nq - s)]
-            idx = np.asarray(idx)[: min(qb, nq - s)]
-            pids = np.where(idx >= 0, ids[np.clip(idx, 0, n - 1)], -1)
-            best_s[s: s + qb], best_i[s: s + qb] = merge_topk_host(
-                best_s[s: s + qb], best_i[s: s + qb],
-                np.where(np.isfinite(sc), sc, -np.inf), pids)
+                    [q, np.zeros((pad_q, dim), q.dtype)])
+            merged_s, merged_i = merge_shard_topk(
+                jnp.asarray(q, jnp.float32), pages, ids, n, mesh, k,
+                np.concatenate([best_s[s: s + qb],
+                                np.full((pad_q, k), -np.inf, np.float32)]),
+                np.concatenate([best_i[s: s + qb],
+                                np.full((pad_q, k), -1, np.int64)]),
+                chunk=chunk)
+            keep = qb - pad_q
+            best_s[s: s + qb] = merged_s[:keep]
+            best_i[s: s + qb] = merged_i[:keep]
     return best_s, best_i

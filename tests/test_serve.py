@@ -1,0 +1,97 @@
+"""SearchService: the loaded-once serving path must return exactly what the
+streaming store search returns (HBM pre-staging is an optimization, not a
+different algorithm), and the interactive CLI must answer a stdin stream."""
+import io
+import json
+import os
+
+import numpy as np
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.serve import SearchService
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.train.loop import Trainer
+
+_OV = {
+    "data.num_pages": 300,
+    "data.trigram_buckets": 2048,
+    "model.embed_dim": 48,
+    "model.conv_channels": 96,
+    "model.out_dim": 48,
+    "train.batch_size": 64,
+    "train.steps": 60,
+    "train.warmup_steps": 10,
+    "train.learning_rate": 2e-3,
+    "train.log_every": 1000,
+    "eval.embed_batch_size": 100,
+    "eval.store_shard_size": 100,   # 3 shards: exercises the shard merge
+}
+
+
+def _trained_service(tmp_path, preload_hbm_gb):
+    cfg = get_config("cdssm_toy", _OV)
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    state, _ = trainer.train()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+    store = VectorStore(os.path.join(str(tmp_path), "store"),
+                        dim=cfg.model.out_dim, shard_size=100)
+    emb.embed_corpus(trainer.corpus, store)
+    svc = SearchService(cfg, emb, trainer.corpus, store,
+                        preload_hbm_gb=preload_hbm_gb)
+    return cfg, trainer, svc
+
+
+def test_preloaded_matches_streaming_and_finds_gold(tmp_path):
+    cfg, trainer, svc = _trained_service(tmp_path, preload_hbm_gb=4.0)
+    assert svc.preloaded
+    # a zero-budget service streams from disk instead
+    stream = SearchService(cfg, svc.embedder, trainer.corpus, svc.store,
+                           preload_hbm_gb=0.0)
+    assert not stream.preloaded
+    hits = 0
+    for qi in (0, 7, 42, 123, 299):
+        query = trainer.corpus.query_text(qi)
+        a = svc.search(query, k=10)
+        b = stream.search(query, k=10)
+        assert [r["page_id"] for r in a] == [r["page_id"] for r in b]
+        np.testing.assert_allclose([r["score"] for r in a],
+                                   [r["score"] for r in b], atol=1e-4)
+        assert all(r["snippet"] for r in a)
+        scores = [r["score"] for r in a]
+        assert scores == sorted(scores, reverse=True)
+        hits += qi in [r["page_id"] for r in a]
+    assert hits >= 4, f"only {hits}/5 gold pages retrieved"
+
+
+def test_cli_interactive_search(tmp_path, capsys, monkeypatch):
+    from dnn_page_vectors_tpu import cli
+    from dnn_page_vectors_tpu.data.toy import ToyCorpus
+
+    wd = str(tmp_path)
+    base = ["--config", "cdssm_toy", "--workdir", wd] + [
+        x for key, val in _OV.items() for x in ("--set", f"{key}={val}")]
+    cli.main(["train"] + base)
+    cli.main(["embed"] + base)
+    capsys.readouterr()
+
+    corpus = ToyCorpus(num_pages=300, seed=0)
+    queries = [corpus.query_text(3), corpus.query_text(250)]
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO("\n".join(queries) + "\n\n"))
+    cli.main(["search", "--interactive"] + base + ["--topk", "10"])
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    ready, answers = lines[0], lines[1:]
+    assert ready["ready"] and ready["vectors"] == 300
+    assert len(answers) == 2
+    hits = 0
+    for qi, ans in zip((3, 250), answers):
+        assert ans["query"] == corpus.query_text(qi)
+        assert len(ans["results"]) == 10
+        assert all(r["snippet"] for r in ans["results"])
+        hits += qi in [r["page_id"] for r in ans["results"]]
+    # 60-step model: not every query lands its gold page at k=10, but a
+    # majority must (random chance per query ~ 10/300)
+    assert hits >= 1, answers
