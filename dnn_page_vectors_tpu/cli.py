@@ -142,7 +142,8 @@ def main(argv=None) -> None:
         model_step = mgr.latest_step() or 0
         mgr.close()
         store = VectorStore(store_dir, dim=cfg.model.out_dim,
-                            shard_size=cfg.eval.store_shard_size)
+                            shard_size=cfg.eval.store_shard_size,
+                            dtype=cfg.eval.store_dtype)
         store.ensure_model_step(model_step)
         print(json.dumps({"store": store_dir, "model_step": model_step}))
         return
@@ -225,7 +226,8 @@ def main(argv=None) -> None:
             writer = args.start // store.manifest["shard_size"]
         elif pi == 0:
             VectorStore(store_dir, dim=cfg.model.out_dim,
-                        shard_size=cfg.eval.store_shard_size
+                        shard_size=cfg.eval.store_shard_size,
+                        dtype=cfg.eval.store_dtype
                         ).ensure_model_step(model_step)
         barrier("store_ready")
         if pc > 1:
@@ -274,7 +276,7 @@ def main(argv=None) -> None:
                             preload_hbm_gb=(4.0 if args.interactive else 0.0))
         if args.interactive:
             import sys
-            svc.warmup()
+            svc.warmup(k=k)
             print(json.dumps({"ready": True, "vectors": store.num_vectors,
                               "hbm_resident": svc.preloaded}), flush=True)
             for line in sys.stdin:

@@ -123,6 +123,10 @@ class EvalConfig:
     # vector-store shard rows: the resume/parallelism unit of the bulk-embed
     # job (one shard = one manifest entry = one fleet work item)
     store_shard_size: int = 65_536
+    # float16 | int8 — int8 stores symmetric per-vector-quantized codes +
+    # fp16 scales: ~2x smaller shards and half the read bandwidth at
+    # 1B-page scale, with recall parity pinned by tests/test_store_quant.py
+    store_dtype: str = "float16"
 
 
 @dataclasses.dataclass(frozen=True)

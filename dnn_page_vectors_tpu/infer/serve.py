@@ -17,13 +17,12 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
 from dnn_page_vectors_tpu.infer.vector_store import VectorStore
-from dnn_page_vectors_tpu.ops.topk import merge_shard_topk, topk_over_store
+from dnn_page_vectors_tpu.ops.topk import (
+    merge_shard_topk, stage_shard, topk_over_store)
 
 
 class SearchService:
@@ -53,19 +52,16 @@ class SearchService:
         return self._shards is not None
 
     def _preload(self, rows: int) -> None:
-        sharding = NamedSharding(self.embedder.mesh, P("data"))
-        shards = []
-        for ids, vecs in self.store.iter_shards():
-            n = vecs.shape[0]
-            buf = np.zeros((rows, self.store.dim), np.float32)
-            buf[:n] = np.asarray(vecs, np.float32)
-            shards.append((np.asarray(ids, np.int64), n,
-                           jax.device_put(buf, sharding)))
-        self._shards = shards
+        self._shards = [
+            (np.asarray(ids, np.int64), vecs.shape[0],
+             stage_shard(vecs, rows, self.store.dim, self.embedder.mesh))
+            for ids, vecs in self.store.iter_shards()]
 
-    def warmup(self) -> None:
-        """Compile the encode + top-k programs before the first query."""
-        self.search("warmup", k=1)
+    def warmup(self, k: Optional[int] = None) -> None:
+        """Compile the encode + top-k programs before the first query.
+        Pass the SAME k the queries will use — the top-k program cache is
+        keyed on it, so a different k would leave the real program cold."""
+        self.search("warmup", k=k)
 
     def search(self, query: str, k: Optional[int] = None) -> List[Dict]:
         k = k or self.cfg.eval.recall_k

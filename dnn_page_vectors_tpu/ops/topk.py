@@ -168,6 +168,15 @@ def merge_topk_host(best_s: np.ndarray, best_i: np.ndarray,
             np.take_along_axis(cat_i, pos, axis=1))
 
 
+def stage_shard(vecs, rows: int, dim: int, mesh: Mesh) -> jax.Array:
+    """Zero-pad one store shard to `rows` (the static compiled shape) and
+    place it row-sharded over the mesh 'data' axis. Shared by the streaming
+    sweep below and the HBM-resident serving path (infer/serve.py)."""
+    buf = np.zeros((rows, dim), np.float32)
+    buf[: vecs.shape[0]] = np.asarray(vecs, np.float32)
+    return jax.device_put(buf, NamedSharding(mesh, P("data")))
+
+
 def merge_shard_topk(q: jnp.ndarray, pages, page_ids: np.ndarray, valid: int,
                      mesh: Mesh, k: int, best_s: np.ndarray,
                      best_i: np.ndarray, chunk: int = 8192
@@ -206,9 +215,7 @@ def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
     qb = min(query_batch, nq)
     for ids, vecs in store.iter_shards():
         n = vecs.shape[0]
-        buf = np.zeros((shard_rows, dim), np.float32)
-        buf[:n] = np.asarray(vecs, np.float32)
-        pages = jax.device_put(buf, NamedSharding(mesh, P("data")))
+        pages = stage_shard(vecs, shard_rows, dim, mesh)
         ids = np.asarray(ids, np.int64)
         for s in range(0, nq, qb):
             q = query_vecs[s: s + qb]

@@ -64,7 +64,8 @@ def run_pipeline(cfg: Config, rounds: int = 2,
             from dnn_page_vectors_tpu.parallel.sharding import shard_params
             embedder.params = shard_params(state.params, trainer.mesh)
         store = VectorStore(store_dir, dim=cfg.model.out_dim,
-                            shard_size=cfg.eval.store_shard_size)
+                            shard_size=cfg.eval.store_shard_size,
+                            dtype=cfg.eval.store_dtype)
         # vectors from older params are stale: reset + stamp the new step
         store.ensure_model_step(int(state.step))
         embedder.embed_corpus(trainer.corpus, store, log=log)

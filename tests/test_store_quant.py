@@ -1,0 +1,76 @@
+"""int8 vector store: quantization round-trip bounds and end-to-end recall
+parity with the fp16 store (eval.store_dtype knob)."""
+import os
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.evals.recall import evaluate_recall
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.train.loop import Trainer
+
+
+def test_int8_round_trip_error_bound(tmp_path):
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=(100, 64)).astype(np.float32)
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    store = VectorStore(str(tmp_path), dim=64, shard_size=128, dtype="int8")
+    store.write_shard(0, np.arange(100), v)
+    ids, got = store.load_shard(0)
+    # symmetric per-row quantization with a shared fp16-rounded scale:
+    # |err| <= scale/2; the fp16 rounding can inflate scale by <= 2^-11
+    bound = ((np.abs(v).max(axis=1) / 254.0) * (1 + 2**-10) + 1e-7)[:, None]
+    assert (np.abs(np.asarray(got) - v) <= bound).all()
+    # int8 codes on disk: vec file ~half the fp16 size
+    vec = os.path.getsize(str(tmp_path / "shard_00000.vec.npy"))
+    assert vec < 100 * 64 * 2  # smaller than the fp16 layout
+    # degenerate all-zero row: no div-by-zero, exact zero round-trip
+    z = np.zeros((3, 64), np.float32)
+    z[1] = v[0]
+    store.write_shard(1, np.arange(100, 103), z)
+    _, got_z = store.load_shard(1)
+    assert np.asarray(got_z)[0].max() == 0.0
+    assert np.asarray(got_z)[2].max() == 0.0
+
+
+def test_int8_store_recall_matches_fp16(tmp_path):
+    cfg = get_config("cdssm_toy", {
+        "data.num_pages": 300,
+        "data.trigram_buckets": 2048,
+        "model.embed_dim": 48,
+        "model.conv_channels": 96,
+        "model.out_dim": 48,
+        "train.batch_size": 64,
+        "train.steps": 60,
+        "train.warmup_steps": 10,
+        "train.learning_rate": 2e-3,
+        "train.log_every": 1000,
+        "eval.embed_batch_size": 100,
+    })
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    state, _ = trainer.train()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+    recalls = {}
+    for dtype in ("float16", "int8"):
+        store = VectorStore(str(tmp_path / f"store_{dtype}"),
+                            dim=cfg.model.out_dim, shard_size=100,
+                            dtype=dtype)
+        emb.embed_corpus(trainer.corpus, store)
+        recalls[dtype], _ = evaluate_recall(emb, trainer.corpus, store,
+                                            num_queries=300, k=10)
+    assert recalls["float16"] > 0.3          # trained above chance (~3%)
+    assert abs(recalls["int8"] - recalls["float16"]) <= 0.02, recalls
+
+
+def test_dtype_switch_requires_reset(tmp_path):
+    store = VectorStore(str(tmp_path), dim=16, shard_size=32, dtype="int8")
+    store.write_shard(0, np.arange(4), np.ones((4, 16), np.float32))
+    with pytest.raises(ValueError, match="dtype"):
+        VectorStore(str(tmp_path), dtype="float16")
+    # empty store adopts the new dtype
+    store.reset()
+    s2 = VectorStore(str(tmp_path), dtype="float16")
+    assert s2.manifest["dtype"] == "float16"
