@@ -36,8 +36,13 @@ import time
 METRIC = "train_pages_per_sec_per_chip"
 UNIT = "pages/sec/chip"
 # Budget knobs (seconds); env-overridable so the driver can tighten them.
-ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "600"))
-TOTAL_BUDGET = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "1500"))
+# The round-4 worker runs FOUR optional sweeps after the required metrics
+# (mt5, long bert, long t5) whose cost is dominated by compiles (~60-90 s
+# each on the tunneled backend) — a 600 s attempt was measured to cut the
+# long phases off, so the default allows one full pass; the record-early
+# protocol still bounds the damage of any overrun to the optional fields.
+ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1100"))
+TOTAL_BUDGET = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "2400"))
 
 
 def _previous_bench() -> float | None:
@@ -108,6 +113,9 @@ def run_worker() -> None:
     # report the best of REPS timed repetitions, the standard estimator for
     # "what the hardware can do" under external interference.
     reps = max(1, int(os.environ.get("BENCH_REPS", "3")))
+    # optional sweeps (mt5, long bert/t5) are secondary datapoints: cap at
+    # best-of-2 so they can't eat the attempt budget (primary keeps `reps`)
+    opt_reps = min(reps, 2)
     batch = per_chip * n_dev
     # TRUE config-3 vocab (VERDICT r3 Missing #4): 100k toy pages supply
     # enough unique words to train the full 30,522-piece WordPiece (~13 s,
@@ -292,7 +300,7 @@ def run_worker() -> None:
                         mstate, mm = mstep(mstate, mbatches[i % 2], mrng)
                     return mm
 
-                mdt = _best_time(_mt5_loop, reps)
+                mdt = _best_time(_mt5_loop, opt_reps)
                 mpps = m_batch * msteps / mdt / n_dev
                 mflops = train_flops_per_pair(mcfg, m_batch)
                 rec.update({
@@ -346,7 +354,7 @@ def run_worker() -> None:
                 lstate, lm = lstep(lstate, lbatches[i % 2], lrng)
             return lm
 
-        ldt = _best_time(_long_loop, reps)
+        ldt = _best_time(_long_loop, opt_reps)
         lpps = lcfg.train.batch_size * lsteps / ldt / n_dev
         lflops = train_flops_per_pair(lcfg, lcfg.train.batch_size)
         rec.update({
@@ -363,7 +371,7 @@ def run_worker() -> None:
         # try/except + error key: a crash here keeps the bert-long numbers
         # above and is distinguishable from a bert-long failure.
         try:
-            _long_t5(rec, n_dev, peak, lsteps, reps, _best_time, _stamp)
+            _long_t5(rec, n_dev, peak, lsteps, opt_reps, _best_time, _stamp)
         except Exception as e:
             rec["long_t5_error"] = f"{type(e).__name__}: {e}"[:300]
     except Exception as e:  # optional sweep must never cost the round
@@ -371,7 +379,7 @@ def run_worker() -> None:
     print(json.dumps(rec), flush=True)
 
 
-def _long_t5(rec, n_dev, peak, lsteps, reps, _best_time, _stamp) -> None:
+def _long_t5(rec, n_dev, peak, lsteps, opt_reps, _best_time, _stamp) -> None:
     import os
 
     from dnn_page_vectors_tpu.config import get_config
@@ -407,7 +415,7 @@ def _long_t5(rec, n_dev, peak, lsteps, reps, _best_time, _stamp) -> None:
             tstate, tm = tstep(tstate, tbatches[i % 2], trng)
         return tm
 
-    tdt = _best_time(_long_t5_loop, reps)
+    tdt = _best_time(_long_t5_loop, opt_reps)
     tpps = tcfg.train.batch_size * lsteps / tdt / n_dev
     tflops = train_flops_per_pair(tcfg, tcfg.train.batch_size)
     rec.update({
@@ -443,11 +451,13 @@ def main() -> None:
     last_err = "no attempts ran"
     while True:
         attempt += 1
+        # effective bound: the attempt knob, clipped by the remaining budget
+        attempt_s = int(min(ATTEMPT_TIMEOUT, max(60, deadline - time.time())))
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--worker"],
                 capture_output=True, text=True,
-                timeout=min(ATTEMPT_TIMEOUT, max(60, deadline - time.time())),
+                timeout=attempt_s,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
             )
             rec = _try_parse_last_json(proc.stdout)
@@ -470,7 +480,7 @@ def main() -> None:
             rec = _try_parse_last_json(partial)
             if rec is not None:
                 rec.setdefault("long_error",
-                               f"timed out after {ATTEMPT_TIMEOUT}s")
+                               f"timed out after {attempt_s}s")
                 print(json.dumps(rec))
                 return
             # surface the worker's progress stamps so the hung stage is named
@@ -479,7 +489,7 @@ def main() -> None:
                 err = err.decode(errors="replace")
             tail = " | ".join(err.strip().splitlines()[-3:])
             last_err = (f"worker attempt {attempt} timed out after "
-                        f"{ATTEMPT_TIMEOUT}s; stderr tail: {tail}")
+                        f"{attempt_s}s; stderr tail: {tail}")
         if time.time() + delay >= deadline:
             break
         time.sleep(delay)

@@ -6,6 +6,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from dnn_page_vectors_tpu.config import get_config
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
@@ -65,6 +66,7 @@ def test_preloaded_matches_streaming_and_finds_gold(tmp_path):
     assert hits >= 4, f"only {hits}/5 gold pages retrieved"
 
 
+@pytest.mark.slow
 def test_cli_interactive_search(tmp_path, capsys, monkeypatch):
     from dnn_page_vectors_tpu import cli
     from dnn_page_vectors_tpu.data.toy import ToyCorpus

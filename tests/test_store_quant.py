@@ -35,6 +35,7 @@ def test_int8_round_trip_error_bound(tmp_path):
     assert np.asarray(got_z)[2].max() == 0.0
 
 
+@pytest.mark.slow
 def test_int8_store_recall_matches_fp16(tmp_path):
     cfg = get_config("cdssm_toy", {
         "data.num_pages": 300,
