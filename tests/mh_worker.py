@@ -62,6 +62,25 @@ def main() -> None:
     flat = np.concatenate(
         [np.asarray(l, np.float32).ravel() for l in leaves])
 
+    # Multi-host checkpointing (SURVEY.md §5.4 at config-4 scale): ALL
+    # processes save collectively into the shared dir, then restore into a
+    # fresh state's (global) shardings — the round trip must reproduce the
+    # live state bit-for-bit on every process.
+    from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"))
+    mgr.save(int(state.step), state, wait=True)
+    restored = mgr.restore(trainer.init_state())
+    mgr.close()
+    assert int(restored.step) == int(state.step), (
+        f"restored step {int(restored.step)} != {int(state.step)}")
+    # bit-for-bit means BYTES (assert_array_equal would let -0.0 == 0.0
+    # canonicalization slip through), and the WHOLE state — a resume with
+    # dropped/zeroed adam moments must fail here, not in production
+    for a, b in zip(jax.tree_util.tree_leaves(restored),
+                    jax.tree_util.tree_leaves(state)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), (
+            "checkpoint round-trip changed state bytes")
+
     # The INFERENCE layer, by contrast, must be exactly topology-invariant,
     # so its comparison runs from bit-identical params by construction:
     # a fresh seeded init (local compute, no collectives involved).
