@@ -31,6 +31,15 @@ def _parse_overrides(pairs) -> Dict[str, object]:
     return out
 
 
+def _prepare_store(store_dir, cfg, model_step):
+    """Stale-safe store open with the configured geometry (ADVICE r4; see
+    infer/vector_store.py:prepare_store)."""
+    from dnn_page_vectors_tpu.infer.vector_store import prepare_store
+    return prepare_store(store_dir, cfg.model.out_dim,
+                         cfg.eval.store_shard_size, cfg.eval.store_dtype,
+                         model_step)
+
+
 def _trainer(cfg):
     from dnn_page_vectors_tpu.train.loop import Trainer
     lookup = None
@@ -74,7 +83,8 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="dnn_page_vectors_tpu")
     ap.add_argument("command", choices=["train", "embed", "eval", "mine",
                                         "search", "pipeline", "configs",
-                                        "init-store", "merge-store"])
+                                        "init-store", "merge-store",
+                                        "reset-store"])
     ap.add_argument("--query", default=None,
                     help="search: free-text query to embed and retrieve for")
     ap.add_argument("--interactive", action="store_true",
@@ -120,6 +130,18 @@ def main(argv=None) -> None:
     # Store-admin commands dispatch BEFORE the trainer build: they need no
     # model, tokenizer, or device — just the store directory and (for
     # init-store) the latest checkpoint step.
+    if args.command == "reset-store":
+        # Explicit administrative drop of all shards — the CLI escape hatch
+        # for the populated-store geometry guard ("cannot switch dtype ...
+        # reset() first"), so switching store_dtype/shard_size on a CURRENT
+        # (non-stale) store never requires Python. Deliberately its own
+        # command: init-store must not silently destroy non-stale vectors.
+        store = VectorStore(store_dir)
+        n = store.num_vectors
+        store.reset()
+        print(json.dumps({"store": store_dir, "dropped_vectors": n}))
+        return
+
     if args.command == "merge-store":
         # Manual-fleet step 3: fold writer manifests into the main one once
         # every slice finished. (The jax.distributed path does this itself
@@ -141,10 +163,7 @@ def main(argv=None) -> None:
         mgr = CheckpointManager(os.path.join(cfg.workdir, "ckpt"))
         model_step = mgr.latest_step() or 0
         mgr.close()
-        store = VectorStore(store_dir, dim=cfg.model.out_dim,
-                            shard_size=cfg.eval.store_shard_size,
-                            dtype=cfg.eval.store_dtype)
-        store.ensure_model_step(model_step)
+        _prepare_store(store_dir, cfg, model_step)
         print(json.dumps({"store": store_dir, "model_step": model_step}))
         return
 
@@ -225,10 +244,7 @@ def main(argv=None) -> None:
             # disjoint writer manifests; see VectorStore multi-writer notes)
             writer = args.start // store.manifest["shard_size"]
         elif pi == 0:
-            VectorStore(store_dir, dim=cfg.model.out_dim,
-                        shard_size=cfg.eval.store_shard_size,
-                        dtype=cfg.eval.store_dtype
-                        ).ensure_model_step(model_step)
+            _prepare_store(store_dir, cfg, model_step)
         barrier("store_ready")
         if pc > 1:
             writer = pi          # the jax.distributed multi-writer path
@@ -278,7 +294,9 @@ def main(argv=None) -> None:
             import sys
             svc.warmup(k=k)
             print(json.dumps({"ready": True, "vectors": store.num_vectors,
-                              "hbm_resident": svc.preloaded}), flush=True)
+                              "hbm_resident": svc.preloaded,
+                              "latency_ms": round(svc.warm_latency_ms, 3)}),
+                  flush=True)
             for line in sys.stdin:
                 query = line.strip()
                 if not query:
@@ -293,12 +311,11 @@ def main(argv=None) -> None:
         from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives
         store = VectorStore(store_dir)
         out = os.path.join(cfg.workdir, "hard_negatives.npy")
+        # out_path at any process count: the miner's writer-slice protocol
+        # keeps peak host memory O(query_block) and barriers internally
         negs = mine_hard_negatives(embedder, trainer.corpus, store,
                                    num_negatives=cfg.train.hard_negatives or 7,
-                                   out_path=(out if pc == 1 else None))
-        if pc > 1 and pi == 0:
-            negs.save(out)
-        barrier("mine_saved")
+                                   out_path=out)
         if pi == 0:
             print(json.dumps({"mined": list(negs.table.shape), "path": out}))
 

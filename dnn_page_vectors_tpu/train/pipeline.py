@@ -18,7 +18,7 @@ import numpy as np
 
 from dnn_page_vectors_tpu.config import Config
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
-from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.infer.vector_store import prepare_store
 from dnn_page_vectors_tpu.mine.ann import HardNegatives, mine_hard_negatives
 from dnn_page_vectors_tpu.train.loop import Trainer
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
@@ -63,11 +63,11 @@ def run_pipeline(cfg: Config, rounds: int = 2,
         else:
             from dnn_page_vectors_tpu.parallel.sharding import shard_params
             embedder.params = shard_params(state.params, trainer.mesh)
-        store = VectorStore(store_dir, dim=cfg.model.out_dim,
-                            shard_size=cfg.eval.store_shard_size,
-                            dtype=cfg.eval.store_dtype)
         # vectors from older params are stale: reset + stamp the new step
-        store.ensure_model_step(int(state.step))
+        # (stale-safe even when geometry overrides changed too, ADVICE r4)
+        store = prepare_store(store_dir, cfg.model.out_dim,
+                              cfg.eval.store_shard_size,
+                              cfg.eval.store_dtype, int(state.step))
         embedder.embed_corpus(trainer.corpus, store, log=log)
         if eval_every_round:
             from dnn_page_vectors_tpu.evals.recall import evaluate_recall

@@ -1,0 +1,78 @@
+"""Subprocess worker for the elastic-restore half of tests/test_multihost.py
+— NOT a test module.
+
+One phase of an elastic training job on the 4-device global mesh: either
+train from scratch and SAVE a collective checkpoint, or RESTORE a
+checkpoint written by a job with a DIFFERENT process count and continue
+training. The parent test chains phases across process topologies
+(1-process save -> 2-process resume, and the reverse) and compares the
+final params to an uninterrupted single-process run (VERDICT r4 Missing
+#3: cross-topology restore had only ever been asserted, not executed).
+
+Usage: python mh_elastic_worker.py PORT NPROC PID WORKDIR MODE STEPS
+  MODE = "save"   — init fresh, train STEPS, save checkpoint (collective)
+         "resume" — restore latest from WORKDIR/ckpt, train STEPS more
+Both modes dump flat fp32 params to WORKDIR/params_after_MODE.npy (pid 0).
+Env:   JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=K
+"""
+import os
+import sys
+
+
+def main() -> None:
+    port, nproc, pid, workdir, mode, steps = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5], int(sys.argv[6]))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nproc, process_id=pid)
+
+    import numpy as np
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+    from dnn_page_vectors_tpu.train.loop import Trainer
+
+    cfg = get_config("cdssm_toy", {
+        "data.num_pages": 64, "data.page_len": 12, "data.query_len": 6,
+        "data.trigram_buckets": 512,
+        "model.conv_channels": 32, "model.embed_dim": 32, "model.out_dim": 32,
+        "mesh.data": 4,
+        "train.batch_size": 8, "train.steps": 8, "train.log_every": 100,
+    }).replace(workdir=workdir)
+
+    trainer = Trainer(cfg)
+    assert trainer.mesh.devices.size == 4
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"))
+    if mode == "save":
+        state = trainer.init_state()
+        state, _ = trainer.train(steps=steps, state=state)
+        mgr.save(int(state.step), state, wait=True)
+    elif mode == "resume":
+        # restore a checkpoint SAVED UNDER A DIFFERENT PROCESS COUNT into
+        # this topology's global shardings, then keep training (the data
+        # cursor re-derives from the restored step, so batch order matches
+        # an uninterrupted run)
+        state = mgr.restore(trainer.init_state())
+        state, _ = trainer.train(steps=steps, state=state)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    mgr.close()
+
+    if pid == 0:
+        leaves = jax.tree_util.tree_leaves(state.params)
+        flat = np.concatenate(
+            [np.asarray(l, np.float32).ravel() for l in leaves])
+        out = os.path.join(workdir, f"params_after_{mode}.npy")
+        with open(out + ".tmp", "wb") as f:
+            np.save(f, flat)
+        os.replace(out + ".tmp", out)
+    if nproc > 1:
+        from dnn_page_vectors_tpu.parallel.multihost import barrier
+        barrier("elastic_done")
+
+
+if __name__ == "__main__":
+    main()

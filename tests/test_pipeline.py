@@ -222,3 +222,32 @@ def test_cli_search_returns_gold_page(tmp_path, capsys):
     # ranked: scores non-increasing
     scores = [r["score"] for r in out["results"]]
     assert scores == sorted(scores, reverse=True)
+
+
+def test_prepare_store_stale_with_geometry_change(tmp_path):
+    """ADVICE r4 (cli.py): a stale store (older model_step) whose
+    shard_size/dtype overrides ALSO changed used to trip the populated-store
+    geometry guard before the stale shards could be dropped. _prepare_store
+    must reset first, then apply the new geometry."""
+    import numpy as np
+
+    from dnn_page_vectors_tpu.cli import _prepare_store
+    from dnn_page_vectors_tpu.config import get_config
+
+    cfg = get_config("cdssm_toy", {"model.out_dim": 16,
+                                   "eval.store_shard_size": 128,
+                                   "eval.store_dtype": "int8"})
+    sd = str(tmp_path / "store")
+    old = VectorStore(sd, dim=16, shard_size=64, dtype="float16")
+    old.ensure_model_step(1)
+    old.write_shard(0, np.arange(4), np.ones((4, 16), np.float32))
+    assert old.num_vectors == 4
+    store = _prepare_store(sd, cfg, model_step=2)
+    assert store.num_vectors == 0                       # stale shards dropped
+    assert store.manifest["shard_size"] == 128          # new geometry applied
+    assert store.manifest["dtype"] == "int8"
+    assert store.manifest["model_step"] == 2
+    # same step + same geometry must be a no-op (resumable work preserved)
+    store.write_shard(0, np.arange(4), np.ones((4, 16), np.float32))
+    again = _prepare_store(sd, cfg, model_step=2)
+    assert again.num_vectors == 4

@@ -87,3 +87,29 @@ def test_chunked_topk_small_corpus():
     assert (i[:, :3] >= 0).all()
     assert (i[:, 3:] == -1).all()
     assert np.isinf(s[:, 3:]).all()
+
+
+def test_topk_over_store_skips_empty_shard(eight_devices, tmp_path):
+    """A zero-count shard (a writer whose whole range was padding) holds an
+    empty page_ids array; the merge must skip it instead of indexing into it
+    (ADVICE r4: page_ids[0] raised IndexError)."""
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+
+    mesh = make_mesh(MeshConfig(data=8))
+    rng = np.random.default_rng(3)
+    dim = 16
+    vecs = rng.normal(size=(40, dim)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    store = VectorStore(str(tmp_path / "store"), dim=dim, shard_size=64)
+    store.write_shard(0, np.arange(40), vecs)
+    # an all-padding write records a count=0 shard entry
+    store.write_shard(1, np.full(8, -1, np.int64), np.zeros((8, dim)))
+    assert [s["count"] for s in store.shards()] == [40, 0]
+    q = rng.normal(size=(5, dim)).astype(np.float32)
+    scores, pids = topk_over_store(q, store, mesh, k=10, chunk=16)
+    ref_s = q @ vecs.astype(np.float16).astype(np.float32).T
+    ref_idx = np.argsort(-ref_s, axis=1)[:, :10]
+    np.testing.assert_allclose(
+        scores, np.take_along_axis(ref_s, ref_idx, axis=1),
+        rtol=1e-4, atol=1e-4)
+    assert (pids >= 0).all() and (pids < 40).all()
