@@ -125,3 +125,29 @@ def test_train_batcher_resume_matches_uninterrupted():
     for _ in range(3):
         np.testing.assert_array_equal(next(resumed)["page_id"],
                                       next(full)["page_id"])
+
+
+def test_synth_jsonl_sharded_generation_matches_single_file(tmp_path):
+    """The documented multi-host generation recipe (data/synth.py: each host
+    writes its block-aligned [start, hi) range to its own file) must
+    reproduce the single-process corpus byte-for-byte when the shards are
+    concatenated — the determinism contract cross-host embed slices rely
+    on. Also pins the aligned-start guard."""
+    import pytest as _pytest
+
+    from dnn_page_vectors_tpu.data.synth import write_synth_jsonl
+
+    full = str(tmp_path / "full.jsonl")
+    write_synth_jsonl(full, 2_000, seed=3, block=512)
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    write_synth_jsonl(a, 1_024, seed=3, block=512, start=0)
+    write_synth_jsonl(b, 2_000, seed=3, block=512, start=1_024)
+    with open(full, "rb") as f:
+        want = f.read()
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        got = fa.read() + fb.read()
+    assert got == want
+    with _pytest.raises(ValueError, match="multiple of"):
+        write_synth_jsonl(str(tmp_path / "c.jsonl"), 2_000, seed=3,
+                          block=512, start=700)

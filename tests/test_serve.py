@@ -128,3 +128,31 @@ def test_service_all_empty_store_streams_and_returns_nothing(tmp_path):
     svc = SearchService(cfg, emb, trainer.corpus, store, preload_hbm_gb=4.0)
     assert not svc.preloaded
     assert svc.search("anything", k=5) == []
+
+
+def test_preloaded_int8_store_matches_streaming(tmp_path):
+    """The HBM-resident serving path over an INT8 store: codes + scales are
+    staged to the device and dequantized inside the top-k matmul; results
+    must equal the streaming path on the same store (both int8, so the
+    comparison isolates the preload/merge machinery, not quantization)."""
+    cfg = get_config("cdssm_toy", dict(_OV, **{"eval.store_dtype": "int8"}))
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    state, _ = trainer.train()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+    store = VectorStore(os.path.join(str(tmp_path), "store"),
+                        dim=cfg.model.out_dim, shard_size=100, dtype="int8")
+    emb.embed_corpus(trainer.corpus, store)
+    svc = SearchService(cfg, emb, trainer.corpus, store, preload_hbm_gb=4.0)
+    stream = SearchService(cfg, emb, trainer.corpus, store,
+                           preload_hbm_gb=0.0)
+    assert svc.preloaded and not stream.preloaded
+    hits = 0
+    for qi in (0, 42, 299):
+        q = trainer.corpus.query_text(qi)
+        a, b = svc.search(q, k=10), stream.search(q, k=10)
+        assert [r["page_id"] for r in a] == [r["page_id"] for r in b]
+        np.testing.assert_allclose([r["score"] for r in a],
+                                   [r["score"] for r in b], atol=1e-4)
+        hits += qi in [r["page_id"] for r in a]
+    assert hits >= 2
