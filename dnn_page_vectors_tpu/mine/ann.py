@@ -45,6 +45,11 @@ class HardNegatives:
         return self.table[gold_ids]
 
     def save(self, path: str) -> None:
+        """Single-process export of an in-memory table. The production
+        persistence path is mine_hard_negatives(out_path=...) — it fills a
+        memmap in query blocks (multi-process slice/merge, O(block) RAM);
+        this helper streams the whole table through np.save and exists for
+        ad-hoc copies of small tables only."""
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:        # file handle: no .npy suffixing
             np.save(f, self.table)
@@ -52,7 +57,10 @@ class HardNegatives:
 
     @classmethod
     def load(cls, path: str) -> "HardNegatives":
-        return cls(np.load(path))
+        # memmap: the config-4 table is ~2.8 GB (100M x 7 int32) and the
+        # batcher only ever gathers [B, H] rows per step — loading it
+        # resident would cost every training process the full table
+        return cls(np.load(path, mmap_mode="r"))
 
 
 def _pick_negatives(retrieved: np.ndarray, gold: np.ndarray,

@@ -77,9 +77,11 @@ def run_pipeline(cfg: Config, rounds: int = 2,
             log.write({"pipeline_round": r, "step": int(state.step),
                        f"recall@{cfg.eval.recall_k}": recall})
         if r + 1 < rounds:                  # last round's mine feeds nothing
+            # out_path: the miner fills a memmap in query blocks and the
+            # returned table is file-backed — the [nq, H] table never has
+            # to fit in RAM, and persistence for resume comes for free
             negs = mine_hard_negatives(
                 embedder, trainer.corpus, store,
-                num_negatives=cfg.train.hard_negatives)
-            negs.save(negs_path)
+                num_negatives=cfg.train.hard_negatives, out_path=negs_path)
             trainer.hard_negative_lookup = negs
     return {"state": state, "recalls": recalls, "negatives": negs}
