@@ -290,6 +290,13 @@ def run_worker() -> None:
                 "data.page_len": 64,
                 "data.tokenize_threads": int(
                     os.environ.get("BENCH_TOKENIZE_THREADS", "8")),
+                # parallel host producer (round 6): N tokenizer workers
+                # read+tokenize batch ranges concurrently and the store
+                # writeback overlaps device compute — the serial producer
+                # held embed-from-text to 57% of the transport ceiling
+                # (BENCH_r05) while the device sat idle between batches
+                "data.tokenize_workers": int(
+                    os.environ.get("BENCH_TOKENIZE_WORKERS", "6")),
                 # 32 batches per dispatch (vs the default 8): the tunneled
                 # chip pays ~100 ms per result materialization, so fewer,
                 # bigger D2H pulls move the from-text rate toward the
@@ -308,12 +315,16 @@ def run_worker() -> None:
                 etrainer.page_tok, etrainer.mesh,
                 query_tok=etrainer.query_tok)
             sdir = os.path.join(tdir, "store")
+            from dnn_page_vectors_tpu.utils.profiling import PipelineProfiler
+            eprof = PipelineProfiler()
 
             def _sweep():
+                eprof.reset()   # summary reported below = the LAST rep's
                 shutil.rmtree(sdir, ignore_errors=True)
                 store = VectorStore(sdir, dim=ecfg.model.out_dim,
                                     shard_size=ecfg.eval.store_shard_size)
-                eembedder.embed_corpus(etrainer.corpus, store)
+                eembedder.embed_corpus(etrainer.corpus, store,
+                                       profiler=eprof)
                 assert store.num_vectors == n_text, store.num_vectors
                 # already host-complete (every vector was materialized into
                 # the store); give _best_time's hard_sync a device no-op
@@ -355,6 +366,13 @@ def run_worker() -> None:
                 "embed_from_text_vs_transport_ceiling": round(
                     min(etext_pps / ceiling, 9.99), 4),
                 "embed_tokenize_threads": ecfg.data.tokenize_threads,
+                "embed_tokenize_workers": ecfg.data.tokenize_workers,
+                # which stage binds (PipelineProfiler; LAST rep's sweep —
+                # read/tokenize are cumulative over the worker pool, so
+                # compare ratios, and produce_wait against wall clock)
+                "embed_stage_seconds": {
+                    k: round(v, 2) for k, v in sorted(
+                        eprof.stages().items())},
             })
             print(json.dumps(rec), flush=True)
 

@@ -250,12 +250,20 @@ def main(argv=None) -> None:
             writer = pi          # the jax.distributed multi-writer path
         store = VectorStore(store_dir, dim=cfg.model.out_dim,
                             writer_id=writer)
+        # per-stage pipeline breakdown (produce_wait/read/tokenize/h2d/
+        # compute/d2h/write) in the final JSON: the operator sees WHICH
+        # stage binds the sweep, not just the end-to-end rate
+        from dnn_page_vectors_tpu.utils.profiling import PipelineProfiler
+        prof = PipelineProfiler()
         with maybe_profile(args.profile, cfg.workdir):
             embedder.embed_corpus(trainer.corpus, store,
-                                  start=args.start, stop=args.stop)
+                                  start=args.start, stop=args.stop,
+                                  profiler=prof)
         if pi == 0:
             print(json.dumps({"embedded": store.num_vectors,
-                              "model_step": model_step}))
+                              "model_step": model_step,
+                              "tokenize_workers": cfg.data.tokenize_workers,
+                              "stages": prof.summary()}))
     elif args.command == "eval":
         from dnn_page_vectors_tpu.evals.recall import evaluate_recall
         store = VectorStore(store_dir)

@@ -35,6 +35,14 @@ class DataConfig:
     # releases the GIL). One thread feeds one chip (~164k pages/s measured);
     # multi-chip hosts (v5e-8) need roughly one thread per 1-2 chips.
     tokenize_threads: int = 1
+    # Tokenizer WORKER pool: >1 runs the per-batch read+tokenize of the
+    # bulk-embed sweep and the train batcher on N concurrent producer
+    # threads, reassembled in batch order (data/loader.py
+    # ordered_parallel_map) — batches stay byte-identical to the serial
+    # path. Orthogonal to tokenize_threads (intra-batch C++ subword
+    # chunking): workers parallelize ACROSS batches, threads WITHIN one.
+    # 1 = serial producer.
+    tokenize_workers: int = 4
     seed: int = 0
 
 
@@ -127,6 +135,11 @@ class EvalConfig:
     # fp16 scales: ~2x smaller shards and half the read bandwidth at
     # 1B-page scale, with recall parity pinned by tests/test_store_quant.py
     store_dtype: str = "float16"
+    # Bounded pending budget of the bulk-embed background writer: how many
+    # finished shards may queue for disk writeback while the device embeds
+    # ahead (infer/bulk_embed.py _ShardWriter). Bounds host memory at
+    # budget * shard_size rows; a slow disk backpressures the device loop.
+    writeback_depth: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

@@ -86,14 +86,19 @@ class JsonlCorpus:
         chip device rate, so the full parse is the difference between the
         host keeping up or not (docs/SCALING.md host budget). Returns None
         whenever the value needs real parsing (escapes / non-string / key
-        absent / any nested object, where a nested key could shadow the
-        top-level one) and the caller falls back to json.loads —
-        correctness never depends on the fast path."""
+        absent / duplicate key / any nested object, where a nested key
+        could shadow the top-level one) and the caller falls back to
+        json.loads — correctness never depends on the fast path.
+        Duplicate keys (ADVICE r5): json.loads keeps the LAST occurrence
+        while a naive find returns the FIRST, so any second occurrence
+        punts to the full parse."""
         if b"\\" in line or line.find(b"{", 1) >= 0:
             return None                       # escapes or nesting: punt
         j = line.find(key)                    # e.g. b'"page":'
         if j < 0:
             return None
+        if line.find(key, j + len(key)) >= 0:
+            return None                   # duplicate key: json semantics
         j += len(key)
         while j < len(line) and line[j] in b" \t":
             j += 1

@@ -9,6 +9,8 @@ Throughput metric: pages/sec/chip (BASELINE.json:2).
 """
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
 import time
 from typing import Iterator, Optional
 
@@ -24,6 +26,107 @@ from dnn_page_vectors_tpu.models.losses import l2_normalize
 from dnn_page_vectors_tpu.parallel.sharding import (
     batch_sharding, replicated, shard_params, stacked_batch_sharding)
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+from dnn_page_vectors_tpu.utils.profiling import PipelineProfiler
+
+
+class _ShardWriter:
+    """Background store writeback: the shard-level np.concatenate +
+    write_shard runs on this thread, so disk writeback of shard i overlaps
+    device compute of shard i+1 instead of stalling the device loop between
+    shards.
+
+    Contract:
+      * bounded pending budget (`max_pending` queued shards) — host memory
+        for not-yet-written shards stays O(budget), and a dead disk
+        backpressures the device loop instead of buffering forever;
+      * the resume manifest records a shard only AFTER write_shard returns
+        (data files synced, then the manifest flush — vector_store.py), so
+        killing the job mid-shard never marks an unwritten shard complete;
+      * the first writer exception is re-raised consumer-side AS ITSELF
+        (the caller's `except SomeError` still matches — writeback moving
+        off-thread must not change the exception surface): submit() raises
+        it promptly (the device loop stops instead of racing ahead), and
+        close() joins the thread and re-raises so embed_corpus can never
+        return with a swallowed write failure.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, store: VectorStore, q8: bool, max_pending: int = 2,
+                 profiler: Optional[PipelineProfiler] = None,
+                 log: Optional[MetricsLogger] = None,
+                 n_dev: int = 1, t0: Optional[float] = None):
+        self._store = store
+        self._q8 = q8
+        self._prof = profiler
+        self._log = log
+        self._n_dev = n_dev
+        self._t0 = time.perf_counter() if t0 is None else t0
+        self._q: "queue_mod.Queue[object]" = queue_mod.Queue(
+            maxsize=max(1, max_pending))
+        self._err: Optional[BaseException] = None
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="shard-writer")
+        self._t.start()
+
+    def submit(self, index: int, ids_acc, vec_acc, scl_acc,
+               pages_so_far: int) -> None:
+        """Queue one finished shard (accumulator lists, concatenated on the
+        writer thread). Blocks while the pending budget is full; raises the
+        writer's error as soon as one exists."""
+        item = (index, ids_acc, vec_acc, scl_acc, pages_so_far)
+        t0 = time.perf_counter()
+        try:
+            while True:
+                if self._err is not None:
+                    raise self._err
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return
+                except queue_mod.Full:
+                    continue
+        finally:
+            if self._prof is not None:
+                self._prof.add("write_wait", time.perf_counter() - t0)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                return
+            if self._err is not None:
+                continue   # drain after failure so submit/close never hang
+            try:
+                index, ids_acc, vec_acc, scl_acc, pages = item
+                t0 = time.perf_counter()
+                ids = np.concatenate(ids_acc)
+                if self._q8:
+                    self._store.write_shard(index, ids,
+                                            codes=np.concatenate(vec_acc),
+                                            scales=np.concatenate(scl_acc))
+                else:
+                    self._store.write_shard(index, ids,
+                                            np.concatenate(vec_acc))
+                now = time.perf_counter()
+                if self._prof is not None:
+                    self._prof.add("write", now - t0)
+                if self._log is not None:
+                    self._log.write({
+                        "bulk_embed_shard": index,
+                        "pages_per_sec_per_chip":
+                            pages / (now - self._t0) / self._n_dev})
+            except BaseException as e:
+                self._err = e
+
+    def close(self, raise_error: bool = True) -> None:
+        """Join the writer (flushing queued shards) and re-raise its first
+        error. raise_error=False is the unwind path when the device loop
+        already holds the primary exception."""
+        if self._t.is_alive():
+            self._q.put(self._SENTINEL)
+            self._t.join()
+        if raise_error and self._err is not None:
+            raise self._err
 
 
 def _stack_batches(it, k: int):
@@ -139,6 +242,13 @@ class BulkEmbedder:
         return jax.device_put(ids, batch_sharding(self.mesh))
 
     def embed_pages(self, ids: np.ndarray) -> np.ndarray:
+        """[B, L(, K)] token ids -> [B, D] L2-normalized page vectors.
+
+        Returns FLOAT16 rows (ADVICE r5): the page tower casts to fp16 on
+        device — the store's own rounding applied before the D2H wire, so
+        the bulk job ships half the bytes; normalization still runs fp32.
+        The query tower (embed_queries) stays fp32: it feeds the fp32 top-k
+        scorer directly and is never bulk traffic."""
         return np.asarray(self._encode_page(self.params, self._put(ids)))
 
     def embed_queries(self, ids: np.ndarray) -> np.ndarray:
@@ -148,7 +258,12 @@ class BulkEmbedder:
                     batch_size: Optional[int] = None) -> np.ndarray:
         """Tokenize + embed a list of texts, padding each batch to the
         compiled batch shape (one XLA program regardless of len(texts)).
-        Shared by the recall eval and the ANN miner."""
+        Shared by the recall eval and the ANN miner.
+
+        Return dtype is per-tower (ADVICE r5): tower="page" yields FLOAT16
+        rows (the on-device store-rounding cast, see embed_pages) while
+        tower="query" yields fp32 — callers mixing towers must not assume
+        a common dtype."""
         tok = self.query_tok if tower == "query" else self.page_tok
         run = self.embed_queries if tower == "query" else self.embed_pages
         bs = batch_size or self.cfg.eval.embed_batch_size
@@ -168,8 +283,28 @@ class BulkEmbedder:
     def embed_corpus(self, corpus: ToyCorpus, store: VectorStore,
                      batch_size: Optional[int] = None, resume: bool = True,
                      log: Optional[MetricsLogger] = None,
-                     start: int = 0, stop: Optional[int] = None) -> VectorStore:
+                     start: int = 0, stop: Optional[int] = None,
+                     workers: Optional[int] = None,
+                     write_pending: Optional[int] = None,
+                     profiler: Optional[PipelineProfiler] = None
+                     ) -> VectorStore:
         """Sweep the corpus into the store, one store-shard at a time.
+
+        Host pipeline: `workers` tokenizer workers (default
+        cfg.data.tokenize_workers) read+tokenize batch id-ranges
+        concurrently, reassembled in order — vectors are byte-identical to
+        the serial path; store writeback runs on a background writer thread
+        with a bounded pending budget (`write_pending`, default
+        cfg.eval.writeback_depth), so the disk write of shard i overlaps
+        device compute of shard i+1. The writer joins — and re-raises —
+        before this method returns; the manifest records a shard only after
+        its files are durably written, so a killed job never resumes past
+        an unwritten shard.
+
+        `profiler` (one is created when omitted) collects the per-stage
+        wall-time breakdown (produce_wait / read / tokenize / h2d / compute
+        / d2h / write / write_wait); the summary lands in the metrics log
+        when `log` is given.
 
         Resume: completed shards are recorded in the store manifest and
         skipped on restart (SURVEY.md §5.3 fault recovery).
@@ -218,69 +353,84 @@ class BulkEmbedder:
         # 1 B/dim instead of 2 — see the q8 encode paths above); fp16 stores
         # ship fp16 rows. Either way the wire carries the stored width.
         q8 = store.manifest["dtype"] == "int8"
+        workers = (self.cfg.data.tokenize_workers if workers is None
+                   else workers)
+        write_pending = (self.cfg.eval.writeback_depth if write_pending is None
+                         else write_pending)
+        prof = PipelineProfiler() if profiler is None else profiler
         t0 = time.perf_counter()
         pages = 0
-        for si in range(start // shard_size, -(-stop // shard_size)):
-            if si in done or si % pc != pi:
-                continue
-            lo = si * shard_size
-            hi = min(lo + shard_size, corpus.num_pages)
-            ids_acc, vec_acc, scl_acc = [], [], []
-            batches = iter_corpus_batches(corpus, self.page_tok, bs,
-                                          start=lo, stop=hi)
-            # clamp to the shard's batch count: a 2-batch shard must not pad
-            # an 8-slot dispatch with 6 all-zero batches
-            E = min(max(1, self.cfg.eval.embed_stack), -(-(hi - lo) // bs))
-            if E > 1:
-                # fuse E batches per dispatch (lax.map; +8% measured at
-                # E=8): the tail group is padded with page_id=-1 batches,
-                # which write_shard drops like any batch padding
-                batches = _stack_batches(batches, E)
-                sharding = stacked_batch_sharding(self.mesh)
-                encode = (self._encode_page_stack_q8 if q8
-                          else self._encode_page_stack)
-            else:
-                sharding = batch_sharding(self.mesh)
-                encode = self._encode_page_q8 if q8 else self._encode_page
-            # Output is double-buffered (VERDICT r1 #8): dispatch batch i's
-            # encode (async under JAX's deferred execution), THEN materialize
-            # batch i-1's vectors — the device->host copy of the previous
-            # batch overlaps the current batch's compute instead of
-            # serializing after it.
-            pending = None
-
-            def _collect(p):
-                nonlocal pages
-                ids = np.asarray(p[0]).reshape(-1)
-                if q8:
-                    codes, scl = p[1]
-                    codes = np.asarray(codes)
-                    vec_acc.append(codes.reshape(-1, codes.shape[-1]))
-                    scl_acc.append(np.asarray(scl).reshape(-1))
+        writer = _ShardWriter(store, q8, max_pending=write_pending,
+                              profiler=prof, log=log, n_dev=n_dev, t0=t0)
+        try:
+            for si in range(start // shard_size, -(-stop // shard_size)):
+                if si in done or si % pc != pi:
+                    continue
+                lo = si * shard_size
+                hi = min(lo + shard_size, corpus.num_pages)
+                ids_acc, vec_acc, scl_acc = [], [], []
+                batches = iter_corpus_batches(corpus, self.page_tok, bs,
+                                              start=lo, stop=hi,
+                                              workers=workers, profiler=prof)
+                # clamp to the shard's batch count: a 2-batch shard must not
+                # pad an 8-slot dispatch with 6 all-zero batches
+                E = min(max(1, self.cfg.eval.embed_stack),
+                        -(-(hi - lo) // bs))
+                if E > 1:
+                    # fuse E batches per dispatch (lax.map; +8% measured at
+                    # E=8): the tail group is padded with page_id=-1 batches,
+                    # which write_shard drops like any batch padding
+                    batches = _stack_batches(batches, E)
+                    sharding = stacked_batch_sharding(self.mesh)
+                    encode = (self._encode_page_stack_q8 if q8
+                              else self._encode_page_stack)
                 else:
-                    vecs = np.asarray(p[1])
-                    vec_acc.append(vecs.reshape(-1, vecs.shape[-1]))
-                ids_acc.append(ids)
-                pages += int((ids >= 0).sum())
+                    sharding = batch_sharding(self.mesh)
+                    encode = self._encode_page_q8 if q8 else self._encode_page
+                # Output is double-buffered (VERDICT r1 #8): dispatch batch
+                # i's encode (async under JAX's deferred execution), THEN
+                # materialize batch i-1's vectors — the device->host copy of
+                # the previous batch overlaps the current batch's compute
+                # instead of serializing after it.
+                pending = None
 
-            for batch in prefetch_to_device(batches, sharding=sharding):
-                vecs = encode(self.params, batch["page"])
+                def _collect(p):
+                    nonlocal pages
+                    with prof.stage("d2h"):
+                        ids = np.asarray(p[0]).reshape(-1)
+                        if q8:
+                            codes, scl = p[1]
+                            codes = np.asarray(codes)
+                            vec_acc.append(
+                                codes.reshape(-1, codes.shape[-1]))
+                            scl_acc.append(np.asarray(scl).reshape(-1))
+                        else:
+                            vecs = np.asarray(p[1])
+                            vec_acc.append(vecs.reshape(-1, vecs.shape[-1]))
+                    ids_acc.append(ids)
+                    pages += int((ids >= 0).sum())
+
+                for batch in prefetch_to_device(batches, sharding=sharding,
+                                                profiler=prof):
+                    with prof.stage("compute"):
+                        vecs = encode(self.params, batch["page"])
+                    if pending is not None:
+                        _collect(pending)
+                    pending = (batch["page_id"], vecs)
                 if pending is not None:
                     _collect(pending)
-                pending = (batch["page_id"], vecs)
-            if pending is not None:
-                _collect(pending)
-            if q8:
-                store.write_shard(si, np.concatenate(ids_acc),
-                                  codes=np.concatenate(vec_acc),
-                                  scales=np.concatenate(scl_acc))
-            else:
-                store.write_shard(si, np.concatenate(ids_acc),
-                                  np.concatenate(vec_acc))
-            if log:
-                dt = time.perf_counter() - t0
-                log.write({"bulk_embed_shard": si,
-                           "pages_per_sec_per_chip": pages / dt / n_dev})
+                # hand the shard to the writer thread: its concat + disk
+                # write overlaps the next shard's device compute; resume
+                # bookkeeping happens inside write_shard after the data is
+                # durably on disk
+                writer.submit(si, ids_acc, vec_acc,
+                              scl_acc if q8 else None, pages)
+        except BaseException:
+            writer.close(raise_error=False)  # primary exception wins
+            raise
+        writer.close()   # join + re-raise any write failure
+        if log:
+            log.write({"bulk_embed_pages": pages, **prof.summary()})
         if pc > 1:
             from dnn_page_vectors_tpu.parallel.multihost import barrier
             barrier("embed_corpus_written")

@@ -148,7 +148,17 @@ class VectorStore:
         tmp = path + f".tmp.{os.getpid()}"   # per-process: no shared tmp file
         with open(tmp, "w") as f:
             json.dump(obj, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())   # durable before the atomic rename
         os.replace(tmp, path)  # atomic: crash-safe resume
+
+    @staticmethod
+    def _fsync_file(path: str) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def _flush_manifest(self) -> None:
         self._atomic_dump(self.manifest, self._manifest_path)
@@ -200,7 +210,14 @@ class VectorStore:
         the store is int8) or, for int8 stores, pre-quantized
         `codes`+`scales` straight off the device (bulk_embed's on-device
         quantize — same math as below, run before the D2H wire so the job
-        moves 1 B/dim instead of 2)."""
+        moves 1 B/dim instead of 2).
+
+        Durability order (the resume invariant bulk_embed's background
+        writer leans on): data files are written AND fsynced first, the
+        manifest entry lands last (itself fsync+atomic-rename) — so a crash
+        at any point either leaves the shard unrecorded (re-embedded on
+        resume) or recorded with all its bytes on disk; never recorded
+        without them."""
         data = vecs if codes is None else codes
         if data.shape[-1] != self.dim:
             raise ValueError(f"vectors are {data.shape[-1]}-d, store is "
@@ -236,6 +253,9 @@ class VectorStore:
         else:
             np.save(vpath, vecs[keep].astype(np.float16))
         np.save(ipath, ids.astype(np.int64))
+        for path in ([vpath, ipath, spath] if "scl" in entry
+                     else [vpath, ipath]):
+            self._fsync_file(path)
         if self._writer_path is not None:
             self._writer_shards = (
                 [s for s in self._writer_shards if s["index"] != index]

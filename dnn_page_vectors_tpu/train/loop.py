@@ -34,6 +34,7 @@ from dnn_page_vectors_tpu.parallel.sharding import (
     stacked_batch_sharding)
 from dnn_page_vectors_tpu.train.optimizer import make_optimizer
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+from dnn_page_vectors_tpu.utils.profiling import PipelineProfiler
 
 
 @flax.struct.dataclass
@@ -159,21 +160,28 @@ class Trainer:
             )
         return self._compiled
 
-    def _make_batcher(self, start_step: int) -> TrainBatcher:
+    def _make_batcher(self, start_step: int,
+                      profiler: Optional[PipelineProfiler] = None
+                      ) -> TrainBatcher:
         return TrainBatcher(
             self.corpus, self.query_tok, self.page_tok,
             batch_size=self.cfg.train.batch_size, seed=self.cfg.train.seed,
             start_step=start_step,
-            hard_negative_lookup=self.hard_negative_lookup)
+            hard_negative_lookup=self.hard_negative_lookup,
+            workers=self.cfg.data.tokenize_workers, profiler=profiler)
 
-    def batches(self, start_step: int = 0) -> Iterator[Any]:
-        return prefetch_to_device(iter(self._make_batcher(start_step)),
-                                  sharding=batch_sharding(self.mesh))
+    def batches(self, start_step: int = 0,
+                profiler: Optional[PipelineProfiler] = None) -> Iterator[Any]:
+        return prefetch_to_device(
+            iter(self._make_batcher(start_step, profiler=profiler)),
+            sharding=batch_sharding(self.mesh), profiler=profiler)
 
-    def stacked_batches(self, start_step: int = 0, k: int = 1) -> Iterator[Any]:
+    def stacked_batches(self, start_step: int = 0, k: int = 1,
+                        profiler: Optional[PipelineProfiler] = None
+                        ) -> Iterator[Any]:
         """[K, B, ...] stacks of K consecutive batches for the scan_steps
         fused dispatch; same data order as batches()."""
-        batcher = self._make_batcher(start_step)
+        batcher = self._make_batcher(start_step, profiler=profiler)
 
         def _stack(it):
             while True:
@@ -184,7 +192,8 @@ class Trainer:
                        for key in group[0]}
 
         return prefetch_to_device(_stack(iter(batcher)),
-                                  sharding=stacked_batch_sharding(self.mesh))
+                                  sharding=stacked_batch_sharding(self.mesh),
+                                  profiler=profiler)
 
     def compiled_multi_step(self, state: TrainState):
         """Train-K-steps-in-one-dispatch: lax.scan over a [K, ...] batch
@@ -216,11 +225,19 @@ class Trainer:
     def train(self, steps: Optional[int] = None,
               state: Optional[TrainState] = None,
               log: Optional[MetricsLogger] = None,
-              ckpt_manager=None) -> Tuple[TrainState, Dict[str, float]]:
+              ckpt_manager=None,
+              profiler: Optional[PipelineProfiler] = None
+              ) -> Tuple[TrainState, Dict[str, float]]:
         """Runs `steps` more steps. The data stream resumes at state.step, so
         a restored run sees the same batch order as an uninterrupted one.
         With ckpt_manager, saves (async) every cfg.train.checkpoint_every
-        steps — the crash-recovery half of SURVEY.md §5.3."""
+        steps — the crash-recovery half of SURVEY.md §5.3.
+
+        Pipeline observability: per-stage wall times (produce_wait / read /
+        tokenize / h2d / compute dispatch) accumulate in `profiler` (one is
+        created when omitted) and land in every logged metrics line as
+        stage_*_s keys — a host-bound run shows up as produce_wait
+        dominating, not as an unexplained low pages/sec."""
         cfg = self.cfg
         steps = cfg.train.steps if steps is None else steps
         state = self.init_state() if state is None else state
@@ -259,17 +276,22 @@ class Trainer:
         peak = device_peak_flops(self.mesh.devices.flat[0])
         flops_pair = train_flops_per_pair(cfg, cfg.train.batch_size)
         start_step = int(state.step)
-        it = (self.stacked_batches(start_step=start_step, k=scan_k)
-              if scan_k > 1 else self.batches(start_step=start_step))
+        prof = PipelineProfiler() if profiler is None else profiler
+        it = (self.stacked_batches(start_step=start_step, k=scan_k,
+                                   profiler=prof)
+              if scan_k > 1 else self.batches(start_step=start_step,
+                                              profiler=prof))
         last: Dict[str, float] = {}
         t0 = time.perf_counter()
         for c in range(steps // scan_k):
             batch = next(it)
-            state, metrics = step_fn(state, batch, base_rng)
+            with prof.stage("compute"):   # dispatch; async past the first
+                state, metrics = step_fn(state, batch, base_rng)
             i = (c + 1) * scan_k         # steps completed this call
             if i % cfg.train.log_every == 0 or i == steps:
                 metrics = {k: float(v) for k, v in metrics.items()}
-                jax.block_until_ready(state.params)
+                with prof.stage("sync"):
+                    jax.block_until_ready(state.params)
                 dt = time.perf_counter() - t0
                 done = int(state.step) - start_step
                 pps_chip = done * pages_per_step / dt / n_dev
@@ -285,6 +307,8 @@ class Trainer:
                 except Exception:
                     pass
                 metrics["step"] = int(state.step)
+                # per-stage pipeline breakdown next to the rate it explains
+                metrics.update(prof.summary())
                 log.write(metrics)
                 last = metrics
             if (ckpt_manager is not None

@@ -1,9 +1,78 @@
 """Tracing/profiling hooks (SURVEY.md §5.1): jax.profiler traces around the
-train/embed hot loops, TensorBoard-readable, behind a --profile CLI flag."""
+train/embed hot loops, TensorBoard-readable, behind a --profile CLI flag —
+plus PipelineProfiler, the per-STAGE wall-time accounting the traces can't
+give cheaply: where an end-to-end pages/sec number hides which stage binds
+(host production vs H2D vs compute vs D2H vs store writeback), the stage
+breakdown says it in one metrics line.
+"""
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import time
+from typing import Dict
+
+
+class PipelineProfiler:
+    """Cumulative per-stage wall time for the host<->device pipelines.
+
+    Stages are free-form names; the bulk-embed and train loops use:
+      produce_wait  consumer blocked waiting for a host batch (prefetch gap)
+      read          corpus record reads inside tokenizer workers
+      tokenize      encode_batch inside tokenizer workers
+      h2d           device_put / make_array_from_process_local_data
+      compute       jitted dispatch (async under JAX — small when pipelined)
+      d2h           materializing device results to numpy
+      write         shard concat + write_shard on the writer thread
+      write_wait    device loop blocked on the bounded writeback budget
+
+    Seconds are CUMULATIVE ACROSS THREADS — a pool of N tokenizer workers
+    adds each worker's time, so `read`/`tokenize` can exceed wall clock.
+    That is the point: the ratios between stages (and the consumer-side
+    `produce_wait`) say which stage binds, not how long the job took.
+    Thread-safe: producers, tokenizer workers, and the writer thread all
+    add into one instance.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sec: Dict[str, float] = {}
+        self._n: Dict[str, int] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._sec[name] = self._sec.get(name, 0.0) + seconds
+            self._n[name] = self._n.get(name, 0) + 1
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sec.clear()
+            self._n.clear()
+
+    def stages(self) -> Dict[str, float]:
+        """{stage: cumulative seconds} snapshot."""
+        with self._lock:
+            return dict(self._sec)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._n)
+
+    def summary(self, prefix: str = "stage_") -> Dict[str, float]:
+        """Flat metrics-ready dict: {f'{prefix}{stage}_s': seconds}. Stable
+        key shape so dashboards/tests can pin on e.g. stage_produce_wait_s."""
+        with self._lock:
+            return {f"{prefix}{k}_s": round(v, 4)
+                    for k, v in sorted(self._sec.items())}
 
 
 @contextlib.contextmanager
