@@ -237,6 +237,7 @@ def run_worker() -> None:
 
     prev = _previous_bench()
     vs = train_pps_chip / prev if prev else 1.0
+    from dnn_page_vectors_tpu.utils import faults
     rec = {
         "metric": METRIC,
         "value": round(train_pps_chip, 2),
@@ -250,6 +251,12 @@ def run_worker() -> None:
         "n_devices": n_dev,
         "device_kind": getattr(devs[0], "device_kind", "unknown"),
         "peak_bf16_flops": peak,
+        # recovery-path activity during the bench (docs/ROBUSTNESS.md):
+        # normally {} / False — a non-empty counter set in a bench record
+        # means the run survived faults (retries, quarantines, rollbacks)
+        # and the numbers were measured on a degraded pipeline
+        "fault_counters": faults.counters(),
+        "degraded": bool(faults.counters()),
     }
     # The REQUIRED metrics are safe from this point: print them before the
     # optional sweeps, and again merged with their fields on success — the

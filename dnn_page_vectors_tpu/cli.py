@@ -106,6 +106,10 @@ def main(argv=None) -> None:
                     help="embed: one-past-last page id (shard aligned)")
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace under workdir/trace")
+    ap.add_argument("--faults", default=None, metavar="PLAN",
+                    help="fault-injection plan 'op:kind:at[:count],...' "
+                         "(utils/faults.py; shorthand for --set "
+                         "faults.plan=...). Off by default.")
     args = ap.parse_args(argv)
 
     if args.command == "configs":
@@ -118,6 +122,14 @@ def main(argv=None) -> None:
     cfg = get_config(args.config, _parse_overrides(args.overrides))
     if args.workdir:
         cfg = cfg.replace(workdir=args.workdir)
+    if args.faults is not None:
+        import dataclasses as _dc
+        cfg = cfg.replace(faults=_dc.replace(cfg.faults, plan=args.faults))
+
+    # fault injection (only when a plan is configured) + the always-on
+    # transient-I/O retry policy — every command goes through this
+    from dnn_page_vectors_tpu.utils import faults
+    faults.install_from_config(cfg)
 
     from dnn_page_vectors_tpu.parallel.mesh import multihost_init
     multihost_init()
@@ -263,7 +275,8 @@ def main(argv=None) -> None:
             print(json.dumps({"embedded": store.num_vectors,
                               "model_step": model_step,
                               "tokenize_workers": cfg.data.tokenize_workers,
-                              "stages": prof.summary()}))
+                              "stages": prof.summary(),
+                              "fault_counters": faults.counters()}))
     elif args.command == "eval":
         from dnn_page_vectors_tpu.evals.recall import evaluate_recall
         store = VectorStore(store_dir)
@@ -296,13 +309,17 @@ def main(argv=None) -> None:
         k = args.topk or cfg.eval.recall_k
         # one-shot queries stream shard-at-a-time (a full HBM preload for a
         # single answer is waste); --interactive pre-stages the store
+        from dnn_page_vectors_tpu.utils.logging import MetricsLogger
         svc = SearchService(cfg, embedder, trainer.corpus, store,
-                            preload_hbm_gb=(4.0 if args.interactive else 0.0))
+                            preload_hbm_gb=(4.0 if args.interactive else 0.0),
+                            log=MetricsLogger(cfg.workdir, echo=False))
         if args.interactive:
             import sys
             svc.warmup(k=k)
             print(json.dumps({"ready": True, "vectors": store.num_vectors,
                               "hbm_resident": svc.preloaded,
+                              "degraded": svc.degraded,
+                              "fault_counters": faults.counters(),
                               "latency_ms": round(svc.warm_latency_ms, 3)}),
                   flush=True)
             for line in sys.stdin:
@@ -314,6 +331,7 @@ def main(argv=None) -> None:
                       flush=True)
         else:
             print(json.dumps({"query": args.query,
+                              "degraded": svc.degraded,
                               "results": svc.search(args.query, k=k)}))
     elif args.command == "mine":
         from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives

@@ -143,6 +143,22 @@ class EvalConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Fault injection + transient-I/O retry policy (utils/faults.py,
+    docs/ROBUSTNESS.md). Injection is OFF unless `plan` is non-empty; the
+    retry policy is always on (real filesystems throw transient errors
+    without any help from us)."""
+    # "op:kind:at[:count],..." — e.g. "shard_write:io_error:1" fails the
+    # second shard write once. Empty = no injection. See utils/faults.py
+    # for the op-name table and docs/ROBUSTNESS.md for the failure model.
+    plan: str = ""
+    seed: int = 0                    # RNG for corruption offsets/bits
+    retry_attempts: int = 3          # total attempts per I/O op
+    retry_backoff_s: float = 0.05    # first backoff; doubles per retry
+    retry_jitter_s: float = 0.02     # uniform jitter added to each backoff
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     name: str
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
@@ -150,6 +166,7 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
+    faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     workdir: str = "/tmp/dnn_page_vectors_tpu"
 
     def to_json(self) -> str:

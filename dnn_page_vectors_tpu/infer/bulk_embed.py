@@ -25,6 +25,7 @@ from dnn_page_vectors_tpu.infer.vector_store import VectorStore
 from dnn_page_vectors_tpu.models.losses import l2_normalize
 from dnn_page_vectors_tpu.parallel.sharding import (
     batch_sharding, replicated, shard_params, stacked_batch_sharding)
+from dnn_page_vectors_tpu.utils import faults
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
 from dnn_page_vectors_tpu.utils.profiling import PipelineProfiler
 
@@ -335,6 +336,15 @@ class BulkEmbedder:
             raise ValueError(f"stop={stop} must be shard-aligned (multiple of "
                              f"{shard_size}) or the corpus end "
                              f"{corpus.num_pages}")
+        if resume:
+            # integrity gate before trusting the manifest (docs/
+            # ROBUSTNESS.md): a shard whose bytes no longer match their
+            # recorded checksum/size is quarantined HERE, so `done` below
+            # excludes it and exactly its id-range is re-embedded — resume
+            # never skips over silently corrupt vectors
+            bad = store.verify()
+            if bad and log:
+                log.write({"bulk_embed_quarantined_shards": bad})
         pi, pc = jax.process_index(), jax.process_count()
         if pc > 1:
             from dnn_page_vectors_tpu.parallel.multihost import is_local_mesh
@@ -430,7 +440,11 @@ class BulkEmbedder:
             raise
         writer.close()   # join + re-raise any write failure
         if log:
-            log.write({"bulk_embed_pages": pages, **prof.summary()})
+            rec = {"bulk_embed_pages": pages, **prof.summary()}
+            fc = faults.counters()
+            if fc:     # recovery-path activity belongs next to the rate
+                rec["fault_counters"] = fc
+            log.write(rec)
         if pc > 1:
             from dnn_page_vectors_tpu.parallel.multihost import barrier
             barrier("embed_corpus_written")

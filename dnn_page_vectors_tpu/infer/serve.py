@@ -12,6 +12,15 @@ static shape so a single compiled top-k program serves all shards) and
 queries never touch disk. Oversized stores transparently fall back to the
 streaming path (ops/topk.py:topk_over_store) — same results, per-query
 disk reads.
+
+Degradation (docs/ROBUSTNESS.md): a shard that FAILS to stage — an I/O
+fault during the device_put, a checksum mismatch, or the HBM budget
+overrunning mid-stage — does not kill the service. Checksum failures are
+quarantined (the store drops them); every other failure falls back
+PER-SHARD to the streaming top-k path: staged shards answer from HBM, the
+failed ones are re-read from disk per query and merged on host. The
+service marks itself `degraded`, bumps fault counters, and reports both
+through the metrics log, so a half-staged service is visible, not silent.
 """
 from __future__ import annotations
 
@@ -23,25 +32,33 @@ import numpy as np
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
 from dnn_page_vectors_tpu.infer.vector_store import VectorStore
 from dnn_page_vectors_tpu.ops.topk import (
-    sharded_topk, stage_shard, topk_over_store)
+    merge_shard_topk, sharded_topk, stage_shard, topk_over_store)
+from dnn_page_vectors_tpu.utils import faults
 
 
 class SearchService:
     def __init__(self, cfg, embedder: BulkEmbedder, corpus,
                  store: VectorStore, preload_hbm_gb: float = 4.0,
-                 snippet_chars: int = 160, query_batch: Optional[int] = None):
+                 snippet_chars: int = 160, query_batch: Optional[int] = None,
+                 log=None):
         self.cfg = cfg
         self.embedder = embedder
         self.corpus = corpus
         self.store = store
         self.snippet_chars = snippet_chars
+        self.degraded = False
+        self.fault_counters: Dict[str, int] = {}
+        self._stream_entries: List[Dict] = []
         # Per-query encode is O(1 query), not the 512-row bulk-embed batch
         # wearing a serving hat (VERDICT r4 Weak #2): queries pad only to a
         # small compiled bucket, rounded UP to the next multiple of the mesh
         # 'data' axis so the batch always shards evenly — max(8, n_data)
         # broke the jitted _encode_query for non-dividing axes like 3/5/6
         # (ADVICE r5). warmup() measures the warm per-query latency.
+        # ONE n_data for the whole service: the ["data"] spelling raised
+        # KeyError on meshes without a 'data' axis.
         n_data = max(embedder.mesh.shape.get("data", 1), 1)
+        self._n_data = n_data
         self.query_batch = query_batch or -(-8 // n_data) * n_data
         self.warm_latency_ms: Optional[float] = None
         self._shards = None  # [(ids np[int64], n, pages [R, D], scl|None)]
@@ -50,9 +67,9 @@ class SearchService:
         # uneven store (merged multi-writer shards) costs
         # n_shards * padded_rows, which can far exceed num_vectors.
         entries = store.shards()
-        n_data = max(embedder.mesh.shape["data"], 1)
         rows = max((s["count"] for s in entries), default=0)
         rows += (-rows) % n_data
+        self._pad_rows = rows
         # budget is PER DEVICE: shards are row-sharded over 'data', so each
         # device holds rows/n_data of every staged shard (ADVICE r4) — at
         # the STORED width (fp16 rows, or int8 codes + fp16 scale per row)
@@ -62,25 +79,75 @@ class SearchService:
         # rows > 0: a store of only zero-count shards has nothing to stage
         # (need == 0 would pass even the explicit never-preload 0.0 budget)
         if entries and rows > 0 and need <= preload_hbm_gb * 2**30:
-            self._preload(rows)
-            if not self._shards:      # nothing survived the non-empty filter
+            self._preload(rows, budget_bytes=preload_hbm_gb * 2**30,
+                          per_row=per_row)
+            if not self._shards:      # nothing survived staging
                 self._shards = None   # stream instead; handles empty stores
+        if log is not None:
+            log.write({
+                "serve_degraded": self.degraded,
+                "serve_hbm_shards": len(self._shards or []),
+                "serve_stream_shards": len(self._stream_entries),
+                "serve_vectors": store.num_vectors,
+                "fault_counters": faults.counters(),
+            })
 
     @property
     def preloaded(self) -> bool:
         return self._shards is not None
 
-    def _preload(self, rows: int) -> None:
+    def _count_fault(self, name: str) -> None:
+        self.fault_counters[name] = self.fault_counters.get(name, 0) + 1
+        faults.count(name)
+
+    def _preload(self, rows: int, budget_bytes: float, per_row: int) -> None:
         import jax
         import jax.numpy as jnp
         from jax import lax
 
-        self._shards = [
-            (np.asarray(ids, np.int64), vecs.shape[0],
-             *stage_shard(vecs, rows, self.store.dim, self.embedder.mesh,
-                          scales=scl))
-            for ids, vecs, scl in self.store.iter_shards(raw=True)
-            if vecs.shape[0] > 0]   # zero-count shards hold nothing to score
+        plan = faults.active()
+        staged = []
+        used = 0.0
+        per_shard = rows * per_row / self._n_data
+        for entry in self.store.shards():
+            if entry["count"] == 0:   # zero-count shards hold nothing to score
+                continue
+            try:
+                plan.check("hbm_stage")
+                err = self.store.entry_error(entry)
+                if err is not None:
+                    # corrupt bytes must never reach the device: quarantine
+                    # drops the shard from the table entirely (its id-range
+                    # returns on the next embed resume), and this service
+                    # serves without it — degraded, visibly
+                    self.store.quarantine(entry, err)
+                    self._count_fault("serve_quarantined_shards")
+                    self.degraded = True
+                    continue
+                if used + per_shard > budget_bytes:
+                    raise MemoryError(
+                        f"HBM budget overrun mid-stage: shard "
+                        f"{entry['index']} needs {per_shard:.0f} B on top of "
+                        f"{used:.0f} staged (budget {budget_bytes:.0f})")
+                ids, vecs, scl = self.store._load_entry(entry, raw=True)
+                staged.append((np.asarray(ids, np.int64), vecs.shape[0],
+                               *stage_shard(vecs, rows, self.store.dim,
+                                            self.embedder.mesh, scales=scl)))
+                used += per_shard
+            except Exception as e:  # noqa: BLE001 — any staging failure
+                # (injected I/O fault, real device OOM, budget overrun)
+                # degrades THIS shard to the streaming path; the service
+                # stays up on the shards that did stage
+                self._stream_entries.append(entry)
+                self.degraded = True
+                self._count_fault("serve_stage_faults")
+                faults.warn(
+                    f"HBM staging failed for shard {entry['index']} "
+                    f"({type(e).__name__}: {e}); serving it via the "
+                    "streaming path (degraded)")
+        self._shards = staged
+        if not staged:
+            return
         # combined-id -> page-id table for the device-side merge below:
         # shard slot s, padded row r  ->  slot s * rows + r
         self._pid_table = np.full((len(self._shards) * rows,), -1, np.int64)
@@ -128,6 +195,8 @@ class SearchService:
                                 / max(1, timing_iters) * 1000.0)
 
     def search(self, query: str, k: Optional[int] = None) -> List[Dict]:
+        import jax.numpy as jnp
+
         k = k or self.cfg.eval.recall_k
         if self._shards is None:
             qv = np.asarray(
@@ -161,7 +230,29 @@ class SearchService:
         top_i = packed[0, k:]
         pids = np.where(top_i >= 0,
                         self._pid_table[np.clip(top_i, 0, None)], -1)
-        return self._format(top_s, pids)
+        if not self._stream_entries:
+            return self._format(top_s, pids)
+        # degraded tail: shards that failed to stage are re-read from disk
+        # and folded into the resident results through the same
+        # merge_shard_topk the streaming path uses — identical results,
+        # per-query disk reads for exactly the failed shards
+        B = self.query_batch
+        best_s = np.full((B, k), -np.inf, np.float32)
+        best_i = np.full((B, k), -1, np.int64)
+        best_s[0] = np.where(np.isfinite(top_s), top_s, -np.inf)
+        best_i[0] = pids
+        qnp = jnp.asarray(np.asarray(q, np.float32))
+        for entry in self._stream_entries:
+            ids, vecs, scl = self.store._load_entry(entry, raw=True)
+            n = vecs.shape[0]
+            if n == 0:
+                continue
+            pages, scales = stage_shard(vecs, self._pad_rows, self.store.dim,
+                                        self.embedder.mesh, scales=scl)
+            best_s, best_i = merge_shard_topk(
+                qnp, pages, np.asarray(ids, np.int64), n,
+                self.embedder.mesh, k, best_s, best_i, scales=scales)
+        return self._format(best_s[0], best_i[0])
 
     def _format(self, scores, ids) -> List[Dict]:
         return [

@@ -6,20 +6,32 @@ deterministically from the restored step (loader.py TrainBatcher.start_step),
 so a resumed run continues the exact batch order of an uninterrupted one.
 Orbax handles multi-host coordination and restore-with-sharding on real
 pods; the same API runs single-process in the sandbox.
+
+Robustness (docs/ROBUSTNESS.md): saves run under the shared transient-I/O
+retry; restore-of-latest VALIDATES the restored pytree (structure, shapes,
+finite floats) and rolls back to the newest OLDER step when the latest
+checkpoint is corrupt — a torn save costs checkpoint_every steps of
+recomputation, never the run. An explicitly requested step never falls
+back: callers asking for step N get step N or a FileNotFoundError naming
+the directory and the steps that do exist.
 """
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
+
+from dnn_page_vectors_tpu.utils import faults
 
 
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self._closed = False
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -27,23 +39,115 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        plan = faults.active()
+        attempt = {"n": 0}
+
+        def _save():
+            plan.check("ckpt_save")
+            try:
+                # a retried attempt may find the step dir half-created by
+                # the failed one; force= overwrites instead of erroring
+                self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=attempt["n"] > 0)
+            finally:
+                attempt["n"] += 1
+
+        faults.retry(_save, op="ckpt_save")
         if wait:
             self._mgr.wait_until_finished()
+        if plan.pending("ckpt_file"):
+            # scheduled on-disk checkpoint corruption: make the save durable
+            # first so the damage hits the finished artifact — exactly what
+            # media rot or a torn write does to a real checkpoint
+            self._mgr.wait_until_finished()
+            plan.corrupt_dir("ckpt_file",
+                             os.path.join(self.directory, str(step)))
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of `state_like` (an abstract
-        or concrete state pytree)."""
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        or concrete state pytree).
+
+        step=None restores the newest step that restores AND validates
+        cleanly, rolling back through older steps when the latest is
+        corrupt (each skip is logged and counted). An explicit step= is a
+        contract, not a preference: a missing step raises FileNotFoundError
+        (directory + available steps), a corrupt one re-raises its error.
+        """
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           state_like)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        steps = self.all_steps()
+        if step is not None:
+            if step not in steps:
+                raise FileNotFoundError(
+                    f"no checkpoint for step {step} in {self.directory} "
+                    f"(available steps: {steps or 'none'})")
+            return self._restore_validated(step, abstract)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        errors = []
+        for s in reversed(steps):
+            try:
+                out = self._restore_validated(s, abstract)
+            except Exception as e:  # noqa: BLE001 — orbax/tensorstore raise
+                # a zoo of exception types for torn files; any of them means
+                # "this checkpoint is unusable", which is exactly the case
+                # rollback exists for
+                errors.append(f"step {s}: {type(e).__name__}: {e}")
+                faults.count("ckpt_restore_failed")
+                continue
+            if errors:
+                faults.count("ckpt_rollback")
+                faults.warn(
+                    f"checkpoint rollback in {self.directory}: restored "
+                    f"step {s}; skipped corrupt newer checkpoint(s): "
+                    + "; ".join(e[:200] for e in errors))
+            return out
+        raise RuntimeError(
+            f"every checkpoint in {self.directory} failed to restore: "
+            + "; ".join(e[:200] for e in errors))
+
+    def _restore_validated(self, step: int, abstract: Any) -> Any:
+        out = self._mgr.restore(step,
+                                args=ocp.args.StandardRestore(abstract))
+        err = _validate_state(out, abstract)
+        if err:
+            raise ValueError(f"restored step {step} failed validation: {err}")
+        return out
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        """Idempotent: a close() in a finally block after an earlier close
+        (or after the manager failed mid-operation) must never raise and
+        mask the original exception."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mgr.wait_until_finished()
+        finally:
+            self._mgr.close()
+
+
+def _validate_state(state: Any, abstract: Any) -> Optional[str]:
+    """Structure + shape/dtype + finiteness check of a restored pytree.
+    Catches the corruption orbax itself can't see: a restore that
+    'succeeded' into the right shapes but carries garbage floats."""
+    got_td = jax.tree_util.tree_structure(state)
+    want_td = jax.tree_util.tree_structure(abstract)
+    if got_td != want_td:
+        return f"tree structure {got_td} != expected {want_td}"
+    for (path, leaf), ref in zip(jax.tree_util.tree_leaves_with_path(state),
+                                 jax.tree_util.tree_leaves(abstract)):
+        name = jax.tree_util.keystr(path)
+        arr = np.asarray(leaf)
+        if tuple(arr.shape) != tuple(ref.shape):
+            return f"{name}: shape {arr.shape} != expected {ref.shape}"
+        if np.issubdtype(arr.dtype, np.floating) and \
+                not np.isfinite(arr).all():
+            return f"{name}: non-finite values"
+    return None

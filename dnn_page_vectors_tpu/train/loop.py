@@ -33,6 +33,7 @@ from dnn_page_vectors_tpu.parallel.sharding import (
     batch_sharding, param_shardings, put_global, replicated, shard_params,
     stacked_batch_sharding)
 from dnn_page_vectors_tpu.train.optimizer import make_optimizer
+from dnn_page_vectors_tpu.utils import faults
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
 from dnn_page_vectors_tpu.utils.profiling import PipelineProfiler
 
@@ -309,6 +310,12 @@ class Trainer:
                 metrics["step"] = int(state.step)
                 # per-stage pipeline breakdown next to the rate it explains
                 metrics.update(prof.summary())
+                # recovery-path activity (injected faults, I/O retries,
+                # checkpoint rollbacks) surfaces in the same line — a run
+                # that limped through failures must say so in its metrics
+                fc = faults.counters()
+                if fc:
+                    metrics["fault_counters"] = fc
                 log.write(metrics)
                 last = metrics
             if (ckpt_manager is not None

@@ -1,0 +1,49 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current jax API (`jax.shard_map` with
+`check_vma=`, `lax.pcast`); CPU dev boxes and CI images may carry an older
+jax where shard_map still lives in `jax.experimental.shard_map` (with the
+`check_rep=` spelling) and `lax.pcast` does not exist yet. Everything that
+needs these goes through this module so the version split lives in exactly
+one place.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+try:                                     # jax >= 0.6: public API
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:                      # older jax: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with the static replication/varying-axis checker off —
+    the documented escape hatch for collective-then-replicated-merge bodies
+    the checker can't infer. Spelled `check_vma=False` on current jax,
+    `check_rep=False` before the rename."""
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: False})
+
+
+def axis_size(axis_name):
+    """STATIC size of a mapped axis from inside shard_map. `lax.axis_size`
+    on current jax; on older jax, `lax.psum(1, axis)` — special-cased for
+    non-tracer args — returns the same concrete Python int."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axes):
+    """lax.pcast(x, axes, to="varying") where available. Older jax has no
+    varying-axis types at all — there a constant carry is already legal
+    under check_rep=False, so the identity is the correct no-op."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axes, to="varying")

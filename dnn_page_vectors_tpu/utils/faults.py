@@ -1,0 +1,293 @@
+"""Deterministic fault injection + transient-I/O retry (docs/ROBUSTNESS.md).
+
+The crash-safety story (manifest-as-resume-unit in infer/vector_store.py,
+deterministic resume-from-step in train/checkpoint.py) is only real if it
+survives actual failures. This module supplies both halves of the proof:
+
+  * `FaultPlan` — a SEEDED schedule of injected faults (`IOError`, file
+    truncation, bit flips, delays) keyed on named operations. Production
+    code calls `active().check(op)` before an I/O or staging operation and
+    `active().corrupt(op, path)` after a file lands on disk; with no plan
+    installed both are ~free no-ops. One plan + one seed reproduces the
+    exact same failure sequence on every run, so every recovery path is a
+    deterministic test, not a prayer.
+
+  * `retry(fn, ...)` — the shared exponential-backoff-with-jitter wrapper
+    for transient I/O, applied to shard writeback, manifest dumps, and
+    checkpoint saves. A transient fault costs a retry; a persistent one
+    re-raises the original exception at the original call site.
+
+  * module-level fault COUNTERS — every injected fault, retry, shard
+    quarantine, checkpoint rollback, and serve degradation bumps a named
+    counter, surfaced through the metrics logs (train/embed/serve) and the
+    bench record so recovery-path activity is observable, not silent.
+
+Injection points (op names):
+  shard_write    write_shard data-file write (check; inside retry)
+  shard_file     the shard .vec.npy after fsync (corrupt)
+  manifest_dump  atomic manifest dump (check; inside retry)
+  manifest_file  the manifest tmp file before its rename (corrupt)
+  shard_read     store shard load (check)
+  ckpt_save      CheckpointManager.save (check; inside retry)
+  ckpt_file      the newest checkpoint step dir after save (corrupt_dir)
+  hbm_stage      per-shard HBM staging in SearchService (check)
+
+Plan syntax (config `faults.plan` / CLI `--faults`):
+  "op:kind:at[:count]" joined by commas; `at` is the 0-based index of the
+  matching call that first faults, `count` how many consecutive calls fault
+  (default 1 = transient; `*` = persistent). Kinds: io_error, truncate,
+  bit_flip, delay. Example — second shard write fails once, the shard-2
+  data file is truncated on disk, the latest checkpoint is torn:
+  "shard_write:io_error:1,shard_file:truncate:2,ckpt_file:truncate:2"
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+KINDS = ("io_error", "truncate", "bit_flip", "delay")
+PERSISTENT = 1_000_000          # `count` spelling of "every call from `at`"
+
+
+class InjectedFault(IOError):
+    """An injected I/O failure. Subclasses IOError/OSError so production
+    retry/except paths treat it exactly like a real transient I/O error —
+    the injection layer must never need special-casing in recovery code."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    op: str
+    kind: str
+    at: int = 0          # 0-based index of the first faulted call
+    count: int = 1       # consecutive calls faulted from `at`
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; have {KINDS}")
+        if self.at < 0 or self.count < 1:
+            raise ValueError(f"bad fault schedule at={self.at} "
+                             f"count={self.count}")
+
+
+class FaultPlan:
+    """A seeded, scheduled set of faults. Thread-safe: the bulk-embed
+    writer thread and tokenizer workers share one plan with the main
+    thread. Deterministic: per-op call counters + one seeded RNG decide
+    exactly which call faults and which byte/bit a corruption touches."""
+
+    def __init__(self, specs: List[FaultSpec] = (), seed: int = 0):
+        self._specs = list(specs)
+        self._rng = random.Random(seed)
+        self._calls: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        specs = []
+        for part in (text or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) not in (2, 3, 4):
+                raise ValueError(
+                    f"bad fault spec {part!r} (want op:kind[:at[:count]])")
+            op, kind = bits[0], bits[1]
+            at = int(bits[2]) if len(bits) > 2 else 0
+            count = (PERSISTENT if len(bits) > 3 and bits[3] in ("*", "inf")
+                     else int(bits[3]) if len(bits) > 3 else 1)
+            specs.append(FaultSpec(op=op, kind=kind, at=at, count=count))
+        return cls(specs, seed=seed)
+
+    def _fire(self, op: str, kinds: tuple) -> Optional[FaultSpec]:
+        """Advance op's call counter; return the spec scheduled to fault
+        THIS call (restricted to `kinds`), if any."""
+        with self._lock:
+            i = self._calls.get(op, 0)
+            self._calls[op] = i + 1
+            for s in self._specs:
+                if (s.op == op and s.kind in kinds
+                        and s.at <= i < s.at + s.count):
+                    return s
+        return None
+
+    def pending(self, op: str) -> bool:
+        """True while any spec for `op` has calls left to fault."""
+        with self._lock:
+            i = self._calls.get(op, 0)
+            return any(s.op == op and i < s.at + s.count
+                       for s in self._specs)
+
+    # -- injection points --------------------------------------------------
+    def check(self, op: str) -> None:
+        """Call before an I/O / staging operation: raises InjectedFault or
+        sleeps when a fault is scheduled for this call of `op`."""
+        if not self._specs:
+            return
+        spec = self._fire(op, ("io_error", "delay"))
+        if spec is None:
+            return
+        count(f"injected_{op}_{spec.kind}")
+        if spec.kind == "delay":
+            with self._lock:
+                t = 0.01 + 0.04 * self._rng.random()
+            time.sleep(t)
+            return
+        raise InjectedFault(f"injected fault: {op} "
+                            f"(call {self._calls[op] - 1}, spec {spec})")
+
+    def corrupt(self, op: str, path: str) -> bool:
+        """Call after a file is durably on disk: applies a scheduled
+        truncation / bit flip to it. Returns True when the file was
+        damaged."""
+        if not self._specs:
+            return False
+        spec = self._fire(op, ("truncate", "bit_flip"))
+        if spec is None:
+            return False
+        self._damage(spec.kind, path)
+        count(f"injected_{op}_{spec.kind}")
+        return True
+
+    def corrupt_dir(self, op: str, directory: str) -> bool:
+        """Like corrupt(), applied to EVERY non-empty file under
+        `directory` (recursively). Checkpoint formats keep redundant copies
+        of array data (e.g. orbax OCDBT), so damaging one file can be
+        silently absorbed; a corrupt-checkpoint injection must reliably
+        break the restore or the rollback path under test never runs."""
+        if not self._specs:
+            return False
+        spec = self._fire(op, ("truncate", "bit_flip"))
+        if spec is None:
+            return False
+        hit = False
+        for root, _, names in os.walk(directory):
+            for n in sorted(names):
+                p = os.path.join(root, n)
+                try:
+                    if os.path.getsize(p) > 0:
+                        self._damage(spec.kind, p)
+                        hit = True
+                except OSError:
+                    continue
+        if hit:
+            count(f"injected_{op}_{spec.kind}")
+        return hit
+
+    def _damage(self, kind: str, path: str) -> None:
+        size = os.path.getsize(path)
+        if kind == "truncate":
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+        else:                                       # bit_flip
+            with self._lock:
+                off = self._rng.randrange(max(size, 1))
+                bit = self._rng.randrange(8)
+            with open(path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([(b[0] if b else 0) ^ (1 << bit)]))
+
+
+_NULL_PLAN = FaultPlan()
+_ACTIVE: FaultPlan = _NULL_PLAN
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Make `plan` the process-wide active plan (injection points are
+    ambient: the store/checkpoint/serve layers must not need a plan handle
+    threaded through every signature)."""
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def install_from_config(cfg) -> Optional[FaultPlan]:
+    """CLI entry: install cfg.faults.plan (when non-empty) and adopt the
+    config's retry policy as the module default."""
+    f = cfg.faults
+    configure_retry(f.retry_attempts, f.retry_backoff_s, f.retry_jitter_s)
+    if not f.plan:
+        return None
+    return install(FaultPlan.parse(f.plan, seed=f.seed))
+
+
+def active() -> FaultPlan:
+    return _ACTIVE
+
+
+def reset() -> None:
+    """Drop the active plan, counters, and retry overrides (test hygiene)."""
+    global _ACTIVE, _RETRY
+    _ACTIVE = _NULL_PLAN
+    _RETRY = dict(_RETRY_DEFAULTS)
+    with _COUNTER_LOCK:
+        _COUNTERS.clear()
+
+
+# -- fault counters ---------------------------------------------------------
+
+_COUNTERS: Dict[str, int] = {}
+_COUNTER_LOCK = threading.Lock()
+
+
+def count(event: str, n: int = 1) -> None:
+    with _COUNTER_LOCK:
+        _COUNTERS[event] = _COUNTERS.get(event, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of every fault/recovery event this process has seen —
+    injected_*, retry_*, quarantined_shards, ckpt_rollback, serve_*."""
+    with _COUNTER_LOCK:
+        return dict(sorted(_COUNTERS.items()))
+
+
+def warn(msg: str) -> None:
+    print(f"WARNING: {msg}", file=sys.stderr)
+
+
+# -- transient-I/O retry ----------------------------------------------------
+
+_RETRY_DEFAULTS = {"attempts": 3, "backoff": 0.05, "jitter": 0.02}
+_RETRY = dict(_RETRY_DEFAULTS)
+
+
+def configure_retry(attempts: int, backoff: float, jitter: float) -> None:
+    _RETRY.update(attempts=max(1, int(attempts)), backoff=float(backoff),
+                  jitter=float(jitter))
+
+
+def retry(fn, op: str = "io", max_attempts: Optional[int] = None,
+          backoff: Optional[float] = None, jitter: Optional[float] = None,
+          retry_on: tuple = (OSError,), profiler=None):
+    """Run fn(); on a transient `retry_on` failure, back off (exponential +
+    uniform jitter) and re-run, up to `max_attempts` total attempts. The
+    final failure re-raises the ORIGINAL exception — callers' except
+    clauses and the resume bookkeeping see the same surface as without
+    retry. Backoff sleep lands in `profiler` as stage `io_retry` when one
+    is passed."""
+    attempts = _RETRY["attempts"] if max_attempts is None else max_attempts
+    base = _RETRY["backoff"] if backoff is None else backoff
+    jit = _RETRY["jitter"] if jitter is None else jitter
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt + 1 >= attempts:
+                raise
+            count(f"retry_{op}")
+            delay = base * (2 ** attempt) + random.uniform(0.0, jit)
+            warn(f"transient {op} failure ({type(e).__name__}: {e}); "
+                 f"retry {attempt + 1}/{attempts - 1} in {delay:.3f}s")
+            t0 = time.perf_counter()
+            time.sleep(delay)
+            if profiler is not None:
+                profiler.add("io_retry", time.perf_counter() - t0)

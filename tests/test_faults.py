@@ -1,0 +1,365 @@
+"""Fault-matrix tests (docs/ROBUSTNESS.md): every recovery path driven by a
+seeded FaultPlan — transient-write retry, persistent-fault re-raise,
+truncated-shard quarantine + re-embed, torn writer manifest, corrupt-latest
+checkpoint rollback, serve degradation — plus the end-to-end
+embed→train-resume→serve run under the combined fault plan."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.serve import SearchService
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+from dnn_page_vectors_tpu.train.loop import Trainer
+from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with no plan installed and zero counters
+    (the module state is process-global by design)."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _cfg(**extra):
+    ov = {
+        "data.num_pages": 256,
+        "data.trigram_buckets": 1024,
+        "model.embed_dim": 32,
+        "model.conv_channels": 32,
+        "model.out_dim": 32,
+        "model.dtype": "float32",
+        "train.batch_size": 64,
+        "train.steps": 6,
+        "train.warmup_steps": 2,
+        "train.log_every": 100,
+        "train.checkpoint_every": 2,
+        "eval.embed_batch_size": 32,
+        "eval.store_shard_size": 64,
+    }
+    ov.update(extra)
+    return get_config("cdssm_toy", ov)
+
+
+def _embedder(cfg, tmp_path, train=False):
+    trainer = Trainer(cfg, workdir=str(tmp_path / "t"))
+    state, _ = (trainer.train() if train else (trainer.init_state(), None))
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+    return trainer, state, emb
+
+
+def _store_bytes(store):
+    ids, vecs = store.load_all()
+    order = np.argsort(ids)
+    return ids[order], np.asarray(vecs)[order]
+
+
+# -- FaultPlan unit behaviour ------------------------------------------------
+
+def test_fault_plan_parse_and_schedule():
+    plan = faults.FaultPlan.parse(
+        "a:io_error:1,b:truncate:0:2,c:delay:0,d:io_error:0:*", seed=7)
+    # a: fires only on the 2nd call
+    plan.check("a")
+    with pytest.raises(faults.InjectedFault):
+        plan.check("a")
+    plan.check("a")                       # transient: exhausted after count=1
+    # d: persistent — every call raises
+    for _ in range(3):
+        with pytest.raises(IOError):      # InjectedFault IS an IOError
+            plan.check("d")
+    assert plan.pending("b") and not plan.pending("a")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("a:nonsense:0")
+
+
+def test_retry_transient_succeeds_persistent_reraises():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert faults.retry(flaky, op="t", backoff=0.001, jitter=0.0) == "ok"
+    assert faults.counters()["retry_t"] == 2
+
+    class Specific(OSError):
+        pass
+
+    def dead():
+        raise Specific("persistent")
+
+    # the ORIGINAL exception type survives the retry wrapper
+    with pytest.raises(Specific):
+        faults.retry(dead, op="p", backoff=0.001, jitter=0.0)
+
+
+# -- store integrity ---------------------------------------------------------
+
+def test_truncated_shard_quarantined_and_reembedded(tmp_path):
+    cfg = _cfg()
+    trainer, _, emb = _embedder(cfg, tmp_path)
+
+    clean = VectorStore(str(tmp_path / "clean"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, clean)
+
+    hurt = VectorStore(str(tmp_path / "hurt"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, hurt)
+    # externally truncate shard 2's vector file (4 shards of 64 pages)
+    victim = os.path.join(hurt.directory, "shard_00002.vec.npy")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+
+    # reopening verifies + quarantines; the shard falls out of the table
+    reopened = VectorStore(str(tmp_path / "hurt"))
+    assert reopened.completed_shards() == {0, 1, 3}
+    assert os.path.exists(victim + ".quarantined")
+    assert faults.counters()["quarantined_shards"] == 1
+
+    # resume re-embeds exactly the quarantined range; bytes match clean
+    emb.embed_corpus(trainer.corpus, reopened)
+    ids_a, vecs_a = _store_bytes(clean)
+    ids_b, vecs_b = _store_bytes(reopened)
+    np.testing.assert_array_equal(ids_a, ids_b)
+    np.testing.assert_array_equal(vecs_a, vecs_b)   # byte-identical fp16
+
+
+def test_bit_flip_detected_by_crc(tmp_path):
+    store = VectorStore(str(tmp_path / "s"), dim=8, shard_size=4)
+    store.write_shard(0, np.arange(4), np.ones((4, 8), np.float32))
+    path = os.path.join(store.directory, "shard_00000.vec.npy")
+    # flip one bit in the payload (past the 128-byte npy header) — size is
+    # unchanged, so only the CRC can catch it
+    with open(path, "r+b") as f:
+        f.seek(130)
+        b = f.read(1)
+        f.seek(130)
+        f.write(bytes([b[0] ^ 0x04]))
+    entry = store.shards()[0]
+    err = store.entry_error(entry)
+    assert err and "CRC" in err
+    assert VectorStore(str(tmp_path / "s")).completed_shards() == set()
+
+
+def test_torn_writer_manifest_quarantined(tmp_path):
+    store = VectorStore(str(tmp_path / "s"), dim=8, shard_size=4)
+    store.write_shard(0, np.arange(4), np.ones((4, 8), np.float32))
+    torn = os.path.join(store.directory, "manifest.w0002.json")
+    with open(torn, "w") as f:
+        f.write('{"shards": [{"index"')      # torn mid-write
+    fresh = VectorStore(str(tmp_path / "s"))
+    assert fresh.completed_shards() == {0}   # reader survives
+    assert not os.path.exists(torn)
+    assert os.path.exists(torn + ".quarantined")
+    assert faults.counters()["quarantined_manifests"] == 1
+
+
+def test_transient_write_fault_retries_inside_embed(tmp_path):
+    cfg = _cfg()
+    trainer, _, emb = _embedder(cfg, tmp_path)
+    faults.install(faults.FaultPlan.parse("shard_write:io_error:1", seed=0))
+    store = VectorStore(str(tmp_path / "s"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, store)    # survives via retry
+    assert store.num_vectors == 256
+    fc = faults.counters()
+    assert fc["injected_shard_write_io_error"] == 1
+    assert fc["retry_shard_write"] == 1
+
+
+def test_persistent_write_fault_reraises_at_close(tmp_path):
+    cfg = _cfg()
+    trainer, _, emb = _embedder(cfg, tmp_path)
+    faults.install(faults.FaultPlan.parse("shard_write:io_error:1:*", seed=0))
+    store = VectorStore(str(tmp_path / "s"), dim=32, shard_size=64)
+    with pytest.raises(IOError):
+        emb.embed_corpus(trainer.corpus, store)
+    # the shard before the persistent fault is durably recorded; resume
+    # bookkeeping is intact
+    assert VectorStore(str(tmp_path / "s")).completed_shards() == {0}
+
+
+# -- checkpoint rollback -----------------------------------------------------
+
+def test_corrupt_latest_checkpoint_rolls_back(tmp_path):
+    cfg = _cfg()
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    state, _ = trainer.train(steps=6, ckpt_manager=mgr)  # saves at 2 and 4
+    mgr.save(6, state, wait=True)
+    assert mgr.all_steps() == [2, 4, 6]
+
+    plan = faults.install(faults.FaultPlan.parse("ckpt_file:truncate:0",
+                                                 seed=1))
+    plan.corrupt_dir("ckpt_file", os.path.join(str(tmp_path / "ckpt"), "6"))
+    restored = mgr.restore(trainer.init_state())
+    assert int(restored.step) == 4
+    fc = faults.counters()
+    assert fc["ckpt_rollback"] == 1 and fc["ckpt_restore_failed"] >= 1
+    # the rolled-back state trains on
+    resumed, _ = trainer.train(steps=2, state=restored)
+    assert int(resumed.step) == 6
+    mgr.close()
+
+
+def test_restore_explicit_missing_step_and_idempotent_close(tmp_path):
+    cfg = _cfg()
+    trainer = Trainer(cfg, workdir=str(tmp_path))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    state = trainer.init_state()
+    mgr.save(2, state, wait=True)
+    with pytest.raises(FileNotFoundError) as ei:
+        mgr.restore(state, step=7)
+    assert "step 7" in str(ei.value) and "[2]" in str(ei.value)
+    empty = CheckpointManager(str(tmp_path / "none"))
+    with pytest.raises(FileNotFoundError):
+        empty.restore(state)
+    # close() twice (e.g. explicit + finally-block cleanup) must not raise
+    mgr.close()
+    mgr.close()
+    empty.close()
+    empty.close()
+
+
+# -- serve degradation -------------------------------------------------------
+
+def test_serve_falls_back_to_streaming_on_staging_fault(tmp_path):
+    cfg = _cfg()
+    trainer, state, emb = _embedder(cfg, tmp_path, train=True)
+    store = VectorStore(str(tmp_path / "s"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, store)
+
+    # ground truth: a fault-free fully-streaming service
+    stream = SearchService(cfg, emb, trainer.corpus, store,
+                           preload_hbm_gb=0.0)
+    assert not stream.preloaded
+
+    # second shard's HBM staging fails -> per-shard streaming fallback
+    faults.install(faults.FaultPlan.parse("hbm_stage:io_error:1", seed=0))
+    log = MetricsLogger(str(tmp_path / "m"), echo=False)
+    svc = SearchService(cfg, emb, trainer.corpus, store, preload_hbm_gb=4.0,
+                        log=log)
+    assert svc.preloaded and svc.degraded
+    assert len(svc._shards) == 3 and len(svc._stream_entries) == 1
+    assert svc.fault_counters["serve_stage_faults"] == 1
+
+    # fault counters are in the metrics log
+    with open(os.path.join(str(tmp_path / "m"), "metrics.jsonl")) as f:
+        rec = json.loads(f.readlines()[-1])
+    assert rec["serve_degraded"] is True
+    assert rec["serve_stream_shards"] == 1
+    assert rec["fault_counters"]["serve_stage_faults"] == 1
+
+    # degraded results == streaming results (same vectors, same ranking)
+    for qi in (0, 42, 200):
+        q = trainer.corpus.query_text(qi)
+        a, b = svc.search(q, k=10), stream.search(q, k=10)
+        assert [r["page_id"] for r in a] == [r["page_id"] for r in b]
+        np.testing.assert_allclose([r["score"] for r in a],
+                                   [r["score"] for r in b], atol=1e-4)
+
+
+def test_serve_quarantines_corrupt_shard_at_staging(tmp_path):
+    cfg = _cfg()
+    trainer, state, emb = _embedder(cfg, tmp_path, train=False)
+    store = VectorStore(str(tmp_path / "s"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, store)
+    # corrupt shard 1 AFTER the store object verified on open
+    victim = os.path.join(store.directory, "shard_00001.vec.npy")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+    svc = SearchService(cfg, emb, trainer.corpus, store, preload_hbm_gb=4.0)
+    assert svc.degraded
+    assert svc.fault_counters["serve_quarantined_shards"] == 1
+    assert store.completed_shards() == {0, 2, 3}    # dropped from the table
+    # the service still answers (without the quarantined range)
+    assert len(svc.search(trainer.corpus.query_text(0), k=5)) == 5
+
+
+# -- the end-to-end acceptance scenario --------------------------------------
+
+def test_e2e_fault_matrix_embed_train_serve(tmp_path):
+    """One seeded plan: a transient write fault, a truncated shard, a
+    corrupt latest checkpoint, and a staging fault — one
+    embed -> resume -> train -> rollback-restore -> serve run survives all
+    four, with byte-identical surviving vectors and visible counters."""
+    cfg = _cfg()
+    trainer = Trainer(cfg, workdir=str(tmp_path / "t"))
+    state = trainer.init_state()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+
+    # fault-free reference store
+    clean = VectorStore(str(tmp_path / "clean"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, clean)
+
+    faults.install(faults.FaultPlan.parse(
+        # embed: 2nd shard write fails once (retried), 3rd shard's file is
+        # truncated on disk after its checksum was recorded
+        "shard_write:io_error:1,shard_file:truncate:2,"
+        # train: the 3rd checkpoint save's files are torn on disk
+        "ckpt_file:truncate:2,"
+        # serve: the 1st shard staging attempt fails
+        "hbm_stage:io_error:0", seed=42))
+
+    # -- embed under faults ------------------------------------------------
+    store = VectorStore(str(tmp_path / "s"), dim=32, shard_size=64)
+    emb.embed_corpus(trainer.corpus, store)      # transient fault retried
+    assert store.num_vectors == 256              # all shards recorded...
+    # ...but shard 2's bytes are silently corrupt; resume catches it
+    resumed = VectorStore(str(tmp_path / "s"))
+    assert resumed.completed_shards() == {0, 1, 3}
+    emb.embed_corpus(trainer.corpus, resumed)    # re-embeds exactly shard 2
+    ids_a, vecs_a = _store_bytes(clean)
+    ids_b, vecs_b = _store_bytes(resumed)
+    np.testing.assert_array_equal(ids_a, ids_b)
+    np.testing.assert_array_equal(vecs_a, vecs_b)
+
+    # -- train with a corrupt latest checkpoint ----------------------------
+    # (train on its OWN state: the compiled step donates its input state,
+    # and the embedder above must keep its params alive for serving)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    tstate, _ = trainer.train(steps=6, ckpt_manager=mgr)
+    mgr.save(6, tstate, wait=True)               # ckpt_file spec tears this
+    restored = mgr.restore(trainer.init_state())
+    assert int(restored.step) == 4               # rolled back
+    tstate, _ = trainer.train(steps=2, state=restored)
+    assert int(tstate.step) == 6                 # resumed to completion
+    mgr.close()
+
+    # -- serve in degraded mode --------------------------------------------
+    log = MetricsLogger(str(tmp_path / "m"), echo=False)
+    svc = SearchService(cfg, emb, trainer.corpus, resumed,
+                        preload_hbm_gb=4.0, log=log)
+    assert svc.preloaded and svc.degraded
+    assert len(svc._stream_entries) == 1
+    stream = SearchService(cfg, emb, trainer.corpus, resumed,
+                           preload_hbm_gb=0.0)
+    for qi in (0, 100):
+        q = trainer.corpus.query_text(qi)
+        a, b = svc.search(q, k=10), stream.search(q, k=10)
+        assert [r["page_id"] for r in a] == [r["page_id"] for r in b]
+
+    # -- every recovery path left a visible counter ------------------------
+    with open(os.path.join(str(tmp_path / "m"), "metrics.jsonl")) as f:
+        rec = json.loads(f.readlines()[-1])
+    fc = rec["fault_counters"]
+    assert fc["injected_shard_write_io_error"] == 1
+    assert fc["retry_shard_write"] == 1
+    assert fc["injected_shard_file_truncate"] == 1
+    assert fc["quarantined_shards"] == 1
+    assert fc["injected_ckpt_file_truncate"] == 1
+    assert fc["ckpt_rollback"] == 1
+    assert fc["injected_hbm_stage_io_error"] == 1
+    assert fc["serve_stage_faults"] == 1

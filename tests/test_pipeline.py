@@ -210,8 +210,13 @@ def test_cli_search_returns_gold_page(tmp_path, capsys):
     cli.main(["embed"] + base)
     capsys.readouterr()
 
-    from dnn_page_vectors_tpu.data.toy import ToyCorpus
-    corpus = ToyCorpus(num_pages=400, seed=0)
+    # the oracle corpus must be built EXACTLY as the pipeline builds it —
+    # a bare ToyCorpus(num_pages, seed) uses different page/query lengths
+    # than cfg.data and generates different text, so its query_text(7)
+    # would never match the trained store
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.data.loader import build_corpus
+    corpus = build_corpus(get_config("cdssm_toy", {"data.num_pages": 400}))
     query = corpus.query_text(7)
     cli.main(["search"] + base + ["--query", query, "--topk", "5"])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])

@@ -80,7 +80,7 @@ def test_preloaded_matches_streaming_and_finds_gold(tmp_path):
 @pytest.mark.slow
 def test_cli_interactive_search(tmp_path, capsys, monkeypatch):
     from dnn_page_vectors_tpu import cli
-    from dnn_page_vectors_tpu.data.toy import ToyCorpus
+    from dnn_page_vectors_tpu.data.loader import build_corpus
 
     wd = str(tmp_path)
     base = ["--config", "cdssm_toy", "--workdir", wd] + [
@@ -89,7 +89,9 @@ def test_cli_interactive_search(tmp_path, capsys, monkeypatch):
     cli.main(["embed"] + base)
     capsys.readouterr()
 
-    corpus = ToyCorpus(num_pages=300, seed=0)
+    # oracle corpus built EXACTLY as the pipeline builds it (a bare
+    # ToyCorpus uses different page/query lengths -> different text)
+    corpus = build_corpus(get_config("cdssm_toy", _OV))
     queries = [corpus.query_text(3), corpus.query_text(250)]
     monkeypatch.setattr("sys.stdin",
                         io.StringIO("\n".join(queries) + "\n\n"))
