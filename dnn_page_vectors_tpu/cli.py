@@ -5,6 +5,7 @@
   python -m dnn_page_vectors_tpu.cli eval  --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli mine  --config hardneg_v5p64
   python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --query "..."
+  python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --queries q.txt
   python -m dnn_page_vectors_tpu.cli pipeline --config hardneg_v5p64 --rounds 4
 
 Any config field is overridable with --set section.field=value; every flag
@@ -87,6 +88,10 @@ def main(argv=None) -> None:
                                         "reset-store"])
     ap.add_argument("--query", default=None,
                     help="search: free-text query to embed and retrieve for")
+    ap.add_argument("--queries", default=None, metavar="FILE",
+                    help="search: batch mode — one query per line, routed "
+                         "through search_many (bucket-filling vectorized "
+                         "dispatch), one JSON result line per query")
     ap.add_argument("--interactive", action="store_true",
                     help="search: serve queries from stdin, one JSON result "
                          "line each (model + store loaded once)")
@@ -116,8 +121,10 @@ def main(argv=None) -> None:
         for name in sorted(CONFIGS):
             print(name)
         return
-    if args.command == "search" and not (args.query or args.interactive):
-        ap.error("search requires --query TEXT (or --interactive)")
+    if args.command == "search" and not (args.query or args.queries
+                                         or args.interactive):
+        ap.error("search requires --query TEXT, --queries FILE, "
+                 "or --interactive")
 
     cfg = get_config(args.config, _parse_overrides(args.overrides))
     if args.workdir:
@@ -308,12 +315,25 @@ def main(argv=None) -> None:
                   "re-run 'embed' for meaningful rankings", file=sys.stderr)
         k = args.topk or cfg.eval.recall_k
         # one-shot queries stream shard-at-a-time (a full HBM preload for a
-        # single answer is waste); --interactive pre-stages the store
+        # single answer is waste); --interactive / --queries pre-stage the
+        # store (a batch file or a stdin session amortizes the staging)
         from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+        preload = 4.0 if (args.interactive or args.queries) else 0.0
         svc = SearchService(cfg, embedder, trainer.corpus, store,
-                            preload_hbm_gb=(4.0 if args.interactive else 0.0),
+                            preload_hbm_gb=preload,
                             log=MetricsLogger(cfg.workdir, echo=False))
-        if args.interactive:
+        if args.queries:
+            # batch mode: every line is a query; the whole file goes through
+            # ONE search_many (bucket-filling tiled dispatch), one JSON
+            # result line per query in input order
+            with open(args.queries) as f:
+                queries = [ln.strip() for ln in f if ln.strip()]
+            results = svc.search_many(queries, k=k)
+            for query, res in zip(queries, results):
+                print(json.dumps({"query": query, "results": res}),
+                      flush=True)
+            svc.close()     # flushes cache/stage counters to the metrics log
+        elif args.interactive:
             import sys
             svc.warmup(k=k)
             print(json.dumps({"ready": True, "vectors": store.num_vectors,
@@ -329,6 +349,7 @@ def main(argv=None) -> None:
                 print(json.dumps({"query": query,
                                   "results": svc.search(query, k=k)}),
                       flush=True)
+            svc.close()
         else:
             print(json.dumps({"query": args.query,
                               "degraded": svc.degraded,

@@ -143,6 +143,33 @@ class EvalConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Query-serving knobs (infer/serve.py, docs/SERVING.md).
+
+    The compiled encode/top-k bucket width itself comes from
+    SearchService.query_batch (mesh-derived); these knobs govern how
+    concurrent traffic is coalesced into that bucket and how repeat
+    queries are deduplicated."""
+    # Micro-batcher window: how long the dispatcher waits for more
+    # concurrent search() callers after the first request arrives before
+    # dispatching the coalesced batch. A lone caller pays at most one
+    # window of extra latency; under load the window fills the compiled
+    # bucket and aggregate QPS scales toward bucket width.
+    batch_window_ms: float = 2.0
+    # Most queries one coalesced dispatch may carry (tiled over full
+    # compiled buckets inside search_many). Bounds per-dispatch latency.
+    max_batch: int = 32
+    # Bounded request queue between callers and the dispatcher thread: a
+    # full queue backpressures callers instead of buffering unboundedly.
+    max_queue: int = 256
+    # LRU query-embedding cache entries (0 disables). Keyed on
+    # whitespace-normalized query text + the store's model step, so
+    # head-of-distribution repeat queries skip tokenize+encode entirely
+    # and a model/store reload (new step) invalidates every entry.
+    query_cache_size: int = 4096
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultConfig:
     """Fault injection + transient-I/O retry policy (utils/faults.py,
     docs/ROBUSTNESS.md). Injection is OFF unless `plan` is non-empty; the
@@ -166,6 +193,7 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     workdir: str = "/tmp/dnn_page_vectors_tpu"
 

@@ -6,41 +6,151 @@ SearchService is the serving path: everything is loaded ONCE (params on
 device, store shards optionally pre-staged in HBM), so per-query cost is
 one tokenize + one compiled encode + MXU top-k over resident vectors.
 
+Throughput layer (docs/SERVING.md): the compiled encode/top-k programs are
+BATCH-shaped (`query_batch` rows), so one-query-at-a-time serving wastes
+most of every dispatch on padding. Three mechanisms recover that width:
+
+  * `search_many(queries, k)` — vectorized multi-query search: one
+    encode_batch over up to `query_batch` real queries, one fused per-shard
+    top-k + device merge, one packed transfer, results split per query;
+    larger lists tile over full buckets (one compiled shape throughout).
+  * a dynamic micro-batcher (`serve.batch_window_ms` / `serve.max_batch`,
+    start_batcher()): concurrent search() callers enqueue onto a bounded
+    queue, a dispatcher thread coalesces whatever arrived within the window
+    into one search_many dispatch, and per-request futures carry results
+    (or exactly the failing request's exception) back to the callers. A
+    lone caller pays at most one window of extra latency; under load the
+    bucket fills and aggregate QPS scales toward bucket width.
+  * an LRU query-embedding cache (`serve.query_cache_size`, keyed on
+    whitespace-normalized query text + the store's model step): repeat
+    queries skip tokenize+encode entirely; a store re-stamp
+    (ensure_model_step / model reload) changes the key and invalidates
+    every entry. Hit/miss counters surface through metrics().
+
 HBM pre-staging: when the store fits the configured budget, every shard is
 device_put once (row-sharded over the mesh 'data' axis, padded to one
 static shape so a single compiled top-k program serves all shards) and
-queries never touch disk. Oversized stores transparently fall back to the
-streaming path (ops/topk.py:topk_over_store) — same results, per-query
-disk reads.
+page vectors never touch disk. Oversized stores transparently fall back to
+the streaming path (ops/topk.py:topk_over_store) — same results, per-query
+disk reads double-buffered behind a reader thread.
 
 Degradation (docs/ROBUSTNESS.md): a shard that FAILS to stage — an I/O
 fault during the device_put, a checksum mismatch, or the HBM budget
 overrunning mid-stage — does not kill the service. Checksum failures are
 quarantined (the store drops them); every other failure falls back
 PER-SHARD to the streaming top-k path: staged shards answer from HBM, the
-failed ones are re-read from disk per query and merged on host. The
-service marks itself `degraded`, bumps fault counters, and reports both
-through the metrics log, so a half-staged service is visible, not silent.
+failed ones are re-read from disk and merged on host — ONCE PER COALESCED
+BATCH, not once per query, so degraded-mode disk traffic amortizes over
+the batch exactly like the device dispatches do. The service marks itself
+`degraded`, bumps fault counters, and reports both through the metrics
+log, so a half-staged service is visible, not silent.
 """
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
 import time
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
-from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore, read_ahead
 from dnn_page_vectors_tpu.ops.topk import (
     merge_shard_topk, sharded_topk, stage_shard, topk_over_store)
 from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils.profiling import LatencyStats, PipelineProfiler
+
+
+class _MicroBatcher:
+    """Dynamic request coalescing for SearchService.search().
+
+    Callers enqueue (query, k, Future) onto a bounded queue; ONE dispatcher
+    thread pulls the first pending request, waits up to `window_ms` for
+    more (never past `max_batch`), and answers the whole batch with one
+    search_many call per distinct k. The bounded queue backpressures
+    callers when the dispatcher falls behind instead of buffering
+    unboundedly — the serving analogue of the bulk-embed writer's pending
+    budget.
+
+    Failure isolation: when a coalesced dispatch raises (one poisoned
+    query must not fail its batch-mates), the batch is retried one request
+    at a time so the exception lands on exactly the failing request's
+    future; the rest still get results.
+    """
+
+    _STOP = object()
+
+    def __init__(self, svc: "SearchService", window_ms: float,
+                 max_batch: int, max_queue: int):
+        self._svc = svc
+        self._window = max(0.0, float(window_ms)) / 1000.0
+        self._max = max(1, int(max_batch))
+        self._q: "queue_mod.Queue[object]" = queue_mod.Queue(
+            maxsize=max(self._max, int(max_queue)))
+        self.batch_sizes: List[int] = []     # dispatch telemetry
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="serve-batcher")
+        self._t.start()
+
+    def submit(self, query: str, k: Optional[int]) -> Future:
+        fut: Future = Future()
+        self._q.put((query, k, fut, time.perf_counter()))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            batch = [item]
+            deadline = time.perf_counter() + self._window
+            while len(batch) < self._max:
+                rem = deadline - time.perf_counter()
+                try:
+                    nxt = (self._q.get_nowait() if rem <= 0
+                           else self._q.get(timeout=rem))
+                except queue_mod.Empty:
+                    break
+                if nxt is self._STOP:
+                    self._dispatch(batch)
+                    return
+                batch.append(nxt)
+            self._dispatch(batch)
+
+    def _dispatch(self, batch) -> None:
+        now = time.perf_counter()
+        for _, _, _, t0 in batch:
+            self._svc.profiler.add("queue_wait", now - t0)
+        self.batch_sizes.append(len(batch))
+        by_k: Dict[Optional[int], list] = {}
+        for query, k, fut, _ in batch:
+            by_k.setdefault(k, []).append((query, fut))
+        for k, items in by_k.items():
+            try:
+                res = self._svc.search_many([q for q, _ in items], k=k)
+            except BaseException:  # noqa: BLE001 — isolate per request
+                for q, fut in items:
+                    try:
+                        fut.set_result(self._svc.search_many([q], k=k)[0])
+                    except BaseException as e:  # noqa: BLE001
+                        fut.set_exception(e)
+                continue
+            for (_, fut), r in zip(items, res):
+                fut.set_result(r)
+
+    def close(self) -> None:
+        self._q.put(self._STOP)
+        self._t.join()
 
 
 class SearchService:
     def __init__(self, cfg, embedder: BulkEmbedder, corpus,
                  store: VectorStore, preload_hbm_gb: float = 4.0,
                  snippet_chars: int = 160, query_batch: Optional[int] = None,
-                 log=None):
+                 log=None, profiler: Optional[PipelineProfiler] = None):
         self.cfg = cfg
         self.embedder = embedder
         self.corpus = corpus
@@ -49,6 +159,23 @@ class SearchService:
         self.degraded = False
         self.fault_counters: Dict[str, int] = {}
         self._stream_entries: List[Dict] = []
+        # per-stage serving breakdown (queue_wait/tokenize/encode/topk/
+        # merge/format) — one shared instance; the batcher and concurrent
+        # callers all add into it
+        self.profiler = profiler or PipelineProfiler()
+        # LRU query-embedding cache: normalized text + the store's model
+        # step -> host fp32 query vector. Step in the KEY means a store
+        # re-stamp (ensure_model_step) invalidates without a flush.
+        serve_cfg = getattr(cfg, "serve", None)
+        self._cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._cache_cap = (serve_cfg.query_cache_size
+                           if serve_cfg is not None else 0)
+        self._cache_lock = threading.Lock()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._batcher: Optional[_MicroBatcher] = None
+        self._batch_sizes: List[int] = []   # telemetry after close()
+        self._log = log
         # Per-query encode is O(1 query), not the 512-row bulk-embed batch
         # wearing a serving hat (VERDICT r4 Weak #2): queries pad only to a
         # small compiled bucket, rounded UP to the next multiple of the mesh
@@ -89,6 +216,8 @@ class SearchService:
                 "serve_hbm_shards": len(self._shards or []),
                 "serve_stream_shards": len(self._stream_entries),
                 "serve_vectors": store.num_vectors,
+                "serve_query_batch": self.query_batch,
+                "serve_query_cache_size": self._cache_cap,
                 "fault_counters": faults.counters(),
             })
 
@@ -180,79 +309,274 @@ class SearchService:
 
         self._merge = jax.jit(merge)
 
+    # -- query-embedding cache --------------------------------------------
+    @staticmethod
+    def _normalize(query: str) -> str:
+        return " ".join(query.split())
+
+    def clear_cache(self) -> None:
+        with self._cache_lock:
+            self._cache.clear()
+
+    def _embed_queries_cached(self, queries: Sequence[str]) -> np.ndarray:
+        """[n] texts -> [n, D] fp32 host query vectors, through the LRU
+        cache; only the misses pay tokenize + compiled encode (in
+        query_batch buckets). Host-side vectors cost the queries one device
+        round trip per bucket — amortized over the coalesced batch, and the
+        price of cache hits skipping the encode dispatch entirely."""
+        prof = self.profiler
+        step = self.store.model_step
+        keys = [(step, self._normalize(q)) for q in queries]
+        out = np.zeros((len(queries), self.store.dim), np.float32)
+        miss: List[int] = []
+        if self._cache_cap > 0:
+            with self._cache_lock:
+                for i, key in enumerate(keys):
+                    vec = self._cache.get(key)
+                    if vec is not None:
+                        self._cache.move_to_end(key)
+                        out[i] = vec
+                        self.cache_hits += 1
+                    else:
+                        miss.append(i)
+                        self.cache_misses += 1
+        else:
+            miss = list(range(len(queries)))
+        if not miss:
+            return out
+        # intra-batch dedup: a coalesced batch of head-skewed traffic
+        # repeats queries — encode each unique missing key once, fan the
+        # vector out to its duplicates (they still count as lookup misses)
+        first: Dict[tuple, int] = {}
+        alias: List[tuple] = []
+        uniq: List[int] = []
+        for i in miss:
+            j = first.get(keys[i])
+            if j is None:
+                first[keys[i]] = i
+                uniq.append(i)
+            else:
+                alias.append((i, j))
+        tok = self.embedder.query_tok or self.embedder.page_tok
+        B = self.query_batch
+        for s in range(0, len(uniq), B):
+            grp = uniq[s: s + B]
+            with prof.stage("tokenize"):
+                enc = tok.encode_batch([queries[i] for i in grp])
+            pad = B - enc.shape[0]
+            if pad:
+                enc = np.concatenate(
+                    [enc, np.zeros((pad,) + enc.shape[1:], enc.dtype)])
+            with prof.stage("encode"):
+                vecs = np.asarray(
+                    self.embedder._encode_query(self.embedder.params,
+                                                self.embedder._put(enc)),
+                    np.float32)[: len(grp)]
+            out[grp] = vecs
+        for i, j in alias:
+            out[i] = out[j]
+        if self._cache_cap > 0:
+            with self._cache_lock:
+                for i in miss:
+                    self._cache[keys[i]] = out[i]
+                    self._cache.move_to_end(keys[i])
+                while len(self._cache) > self._cache_cap:
+                    self._cache.popitem(last=False)
+        return out
+
+    # -- micro-batcher -----------------------------------------------------
+    def start_batcher(self) -> "SearchService":
+        """Route subsequent search() calls through the dynamic micro-batcher
+        (serve.batch_window_ms / serve.max_batch): concurrent callers
+        coalesce into shared search_many dispatches. Idempotent; close()
+        stops it."""
+        if self._batcher is None:
+            s = self.cfg.serve
+            self._batcher = _MicroBatcher(self, s.batch_window_ms,
+                                          s.max_batch, s.max_queue)
+        return self
+
+    @property
+    def batching(self) -> bool:
+        return self._batcher is not None
+
+    def close(self) -> None:
+        if self._batcher is not None:
+            self._batcher.close()
+            # telemetry survives the thread: metrics() after close still
+            # reports what the batcher did
+            self._batch_sizes = self._batcher.batch_sizes
+            self._batcher = None
+        if self._log is not None:
+            self._log.write(self.metrics())
+
+    def __enter__(self) -> "SearchService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def metrics(self) -> Dict:
+        """Serving counters + the per-stage breakdown, metrics-log ready."""
+        total = self.cache_hits + self.cache_misses
+        rec = {
+            "serve_degraded": self.degraded,
+            "serve_cache_hits": self.cache_hits,
+            "serve_cache_misses": self.cache_misses,
+            "serve_cache_hit_rate": round(self.cache_hits / total, 4)
+            if total else 0.0,
+            **self.profiler.summary(prefix="serve_stage_"),
+        }
+        sizes = (self._batcher.batch_sizes if self._batcher is not None
+                 else self._batch_sizes)
+        if sizes:
+            rec["serve_batches"] = len(sizes)
+            rec["serve_mean_batch"] = round(sum(sizes) / len(sizes), 2)
+        if self.fault_counters:
+            rec["fault_counters"] = faults.counters()
+        return rec
+
+    # -- search ------------------------------------------------------------
     def warmup(self, k: Optional[int] = None, timing_iters: int = 3) -> None:
         """Compile the encode + top-k programs before the first query, then
-        time `timing_iters` warm searches (median-free mean; results are
-        fully materialized to host, so the clock covers tokenize + encode +
-        top-k + snippet end-to-end) into `warm_latency_ms`. Pass the SAME k
-        the queries will use — the top-k program cache is keyed on it, so a
-        different k would leave the real program cold."""
-        self.search("warmup", k=k)
-        t0 = time.perf_counter()
-        for _ in range(max(1, timing_iters)):
-            self.search("warmup", k=k)
-        self.warm_latency_ms = ((time.perf_counter() - t0)
-                                / max(1, timing_iters) * 1000.0)
+        time `timing_iters` warm searches (MEDIAN, so one GC pause or
+        tunnel hiccup can't skew the reported number; results are fully
+        materialized to host, so the clock covers tokenize + encode +
+        top-k + snippet end-to-end) into `warm_latency_ms`. The cache is
+        bypassed while timing — warm latency means the real encode path,
+        not a dictionary lookup. Pass the SAME k the queries will use —
+        the top-k program cache is keyed on it, so a different k would
+        leave the real program cold."""
+        self.search_many(["warmup"], k=k)
+        lat = LatencyStats()
+        cap, self._cache_cap = self._cache_cap, 0
+        try:
+            for _ in range(max(1, timing_iters)):
+                with lat.timed():
+                    self.search_many(["warmup"], k=k)
+        finally:
+            self._cache_cap = cap
+        self.warm_latency_ms = lat.percentile_ms(50)
 
     def search(self, query: str, k: Optional[int] = None) -> List[Dict]:
+        """One query -> top-k results. With the micro-batcher running
+        (start_batcher), the call enqueues and blocks on its future —
+        concurrent callers share dispatches; otherwise it is a direct
+        single-query search_many."""
+        b = self._batcher
+        if b is not None:
+            return b.submit(query, k).result()
+        return self.search_many([query], k=k)[0]
+
+    def search_many(self, queries: Sequence[str],
+                    k: Optional[int] = None) -> List[List[Dict]]:
+        """Vectorized multi-query search: one result list per query, in
+        order. Queries fill the compiled `query_batch` bucket (larger lists
+        tile over full buckets — one compiled program regardless of count);
+        per-shard top-k and the cross-shard merge run once per bucket, and
+        on a degraded service the failed shards' disk sweep folds in once
+        per bucket too."""
+        k = k or self.cfg.eval.recall_k
+        n = len(queries)
+        if n == 0:
+            return []
+        qv = self._embed_queries_cached(list(queries))
+        prof = self.profiler
+        B = self.query_batch
+        if self._shards is None:
+            # streaming store: pad the query matrix to a bucket multiple so
+            # every call reuses one compiled shape, then sweep disk ONCE
+            # for the whole list
+            pad = (-n) % B
+            if pad:
+                qv = np.concatenate(
+                    [qv, np.zeros((pad, qv.shape[1]), np.float32)])
+            with prof.stage("topk"):
+                scores, ids = topk_over_store(qv, self.store,
+                                              self.embedder.mesh, k=k,
+                                              query_batch=B)
+            with prof.stage("format"):
+                return [self._format(scores[i], ids[i]) for i in range(n)]
+        # Two passes over the buckets: dispatch them ALL first (the merge
+        # output stays on device — JAX's async queue runs bucket i+1's
+        # top-k while bucket i's packed transfer drains), THEN materialize
+        # and format in order. A >bucket batch therefore pipelines compute
+        # against transfer instead of serializing dispatch/drain per
+        # bucket.
+        pending = [self._dispatch_bucket(qv[s: s + B], k)
+                   for s in range(0, n, B)]
+        out: List[List[Dict]] = []
+        for nreal, q, packed in pending:
+            out.extend(self._collect_bucket(nreal, q, packed, k))
+        return out
+
+    def _dispatch_bucket(self, qblock: np.ndarray, k: int):
+        """HBM-resident fast path for ONE compiled bucket (<= query_batch
+        real rows): every resident shard's top-k program dispatches under
+        JAX's async queue and the cross-shard merge runs ON DEVICE; the
+        packed [B, 2k] result is returned still on device — exactly ONE
+        drain round trip per BUCKET happens later in _collect_bucket,
+        regardless of shard count or how many queries share the dispatch.
+        (The old per-shard host merge cost ~2 transfers per shard: ~100 ms
+        each over a tunneled chip, and a forced pipeline bubble even on
+        local PCIe.)"""
         import jax.numpy as jnp
 
-        k = k or self.cfg.eval.recall_k
-        if self._shards is None:
-            qv = np.asarray(
-                self.embedder.embed_texts([query], tower="query",
-                                          batch_size=self.query_batch),
-                np.float32)
-            scores, ids = topk_over_store(qv, self.store,
-                                          self.embedder.mesh, k=k)
-            return self._format(scores[0], ids[0])
-        # HBM-resident fast path: the query vector NEVER round-trips to the
-        # host, every resident shard's top-k program dispatches under JAX's
-        # async queue, the cross-shard merge runs ON DEVICE, and exactly ONE
-        # packed array comes back — one drain round trip per query total,
-        # regardless of shard count. (The old per-shard host merge cost ~2
-        # transfers per shard: ~100 ms each over a tunneled chip, and a
-        # forced pipeline bubble even on local PCIe.)
-        tok = self.embedder.query_tok or self.embedder.page_tok
-        enc = tok.encode_batch([query])
-        pad = self.query_batch - enc.shape[0]
-        if pad:
-            enc = np.concatenate(
-                [enc, np.zeros((pad,) + enc.shape[1:], enc.dtype)])
-        q = self.embedder._encode_query(self.embedder.params,
-                                        self.embedder._put(enc))
-        cands = [
-            sharded_topk(q, pages, self.embedder.mesh, k=k, valid=n,
-                         scales=scl)
-            for _, n, pages, scl in self._shards]
-        packed = np.asarray(self._merge(cands))           # the one transfer
-        top_s = np.ascontiguousarray(packed[:1, :k]).view(np.float32)[0]
-        top_i = packed[0, k:]
+        prof = self.profiler
+        nreal = qblock.shape[0]
+        B = self.query_batch
+        if nreal < B:
+            qblock = np.concatenate(
+                [qblock, np.zeros((B - nreal, qblock.shape[1]), np.float32)])
+        q = jnp.asarray(qblock, jnp.float32)
+        with prof.stage("topk"):
+            cands = [
+                sharded_topk(q, pages, self.embedder.mesh, k=k, valid=n,
+                             scales=scl)
+                for _, n, pages, scl in self._shards]
+            packed = self._merge(cands)                # async, on device
+        return nreal, q, packed
+
+    def _collect_bucket(self, nreal: int, q, packed, k: int
+                        ) -> List[List[Dict]]:
+        prof = self.profiler
+        with prof.stage("merge"):
+            packed = np.asarray(packed)                # the one transfer
+        top_s = np.ascontiguousarray(packed[:, :k]).view(np.float32)
+        top_i = packed[:, k:]
         pids = np.where(top_i >= 0,
                         self._pid_table[np.clip(top_i, 0, None)], -1)
         if not self._stream_entries:
-            return self._format(top_s, pids)
+            with prof.stage("format"):
+                return [self._format(top_s[i], pids[i])
+                        for i in range(nreal)]
         # degraded tail: shards that failed to stage are re-read from disk
-        # and folded into the resident results through the same
-        # merge_shard_topk the streaming path uses — identical results,
-        # per-query disk reads for exactly the failed shards
-        B = self.query_batch
-        best_s = np.full((B, k), -np.inf, np.float32)
-        best_i = np.full((B, k), -1, np.int64)
-        best_s[0] = np.where(np.isfinite(top_s), top_s, -np.inf)
-        best_i[0] = pids
-        qnp = jnp.asarray(np.asarray(q, np.float32))
-        for entry in self._stream_entries:
-            ids, vecs, scl = self.store._load_entry(entry, raw=True)
-            n = vecs.shape[0]
-            if n == 0:
-                continue
-            pages, scales = stage_shard(vecs, self._pad_rows, self.store.dim,
-                                        self.embedder.mesh, scales=scl)
-            best_s, best_i = merge_shard_topk(
-                qnp, pages, np.asarray(ids, np.int64), n,
-                self.embedder.mesh, k, best_s, best_i, scales=scales)
-        return self._format(best_s[0], best_i[0])
+        # — ONCE for the whole bucket, prefetched one shard ahead on a
+        # reader thread — and folded into the resident results through the
+        # same merge_shard_topk the streaming path uses: identical results,
+        # per-bucket disk reads for exactly the failed shards
+        best_s = np.where(np.isfinite(top_s), top_s, -np.inf).astype(
+            np.float32)
+        best_i = pids.astype(np.int64)
+
+        def _load_tail():
+            for entry in self._stream_entries:
+                ids, vecs, scl = self.store._load_entry(entry, raw=True)
+                yield np.asarray(ids, np.int64), np.asarray(vecs), scl
+
+        with prof.stage("topk"):
+            for ids, vecs, scl in read_ahead(_load_tail(), depth=1):
+                nrows = vecs.shape[0]
+                if nrows == 0:
+                    continue
+                pages, scales = stage_shard(vecs, self._pad_rows,
+                                            self.store.dim,
+                                            self.embedder.mesh, scales=scl)
+                best_s, best_i = merge_shard_topk(
+                    q, pages, ids, nrows, self.embedder.mesh, k,
+                    best_s, best_i, scales=scales)
+        with prof.stage("format"):
+            return [self._format(best_s[i], best_i[i]) for i in range(nreal)]
 
     def _format(self, scores, ids) -> List[Dict]:
         return [

@@ -42,12 +42,66 @@ from __future__ import annotations
 import glob
 import json
 import os
+import queue as queue_mod
+import threading
 import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from dnn_page_vectors_tpu.utils import faults
+
+
+def read_ahead(it, depth: int = 1):
+    """Depth-bounded background reader: drain `it` (a shard-loading
+    iterator) on a reader thread so the NEXT shard's disk read overlaps the
+    consumer's device work on the current one — the streaming top-k sweep
+    (ops/topk.py:topk_over_store) and the degraded-tail serving loop
+    (infer/serve.py) otherwise read each shard synchronously between device
+    dispatches. Mirrors the bulk-embed writer contract (infer/bulk_embed.py
+    _ShardWriter): bounded queue (a slow consumer backpressures the reader,
+    host memory stays O(depth) pending shards) and join-and-reraise — the
+    reader's first exception surfaces at the consumer AS ITSELF, so an
+    `except IOError` around the sweep matches exactly as it did serially.
+    """
+    q: "queue_mod.Queue[object]" = queue_mod.Queue(maxsize=max(1, depth))
+    done = object()
+    stop = threading.Event()
+    err: List[BaseException] = []
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _read():
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            err.append(e)
+        finally:
+            _put(done)
+
+    t = threading.Thread(target=_read, daemon=True, name="shard-reader")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                break
+            yield item
+    finally:
+        # abandoning consumer (early break / error): unblock the reader
+        stop.set()
+        t.join()
+        if err:
+            raise err[0]
 
 
 def _crc_file(path: str) -> int:
@@ -168,6 +222,13 @@ class VectorStore:
     @property
     def num_vectors(self) -> int:
         return sum(s["count"] for s in self.shards())
+
+    @property
+    def model_step(self) -> Optional[int]:
+        """The model step this store's vectors were embedded at (None for a
+        pre-stamp store). Serving keys its query-embedding cache on this, so
+        ensure_model_step / a store reload invalidates cached embeddings."""
+        return self.manifest.get("model_step")
 
     def _writer_files(self) -> List[str]:
         return sorted(p for p in glob.glob(
@@ -497,7 +558,21 @@ class VectorStore:
                     np.zeros((0, self.dim), np.float16))
         return np.concatenate(ids_list), np.concatenate(vec_list)
 
-    def iter_shards(self, raw: bool = False):
+    def iter_shards(self, raw: bool = False, prefetch: int = 0):
+        """Yield every shard's arrays. `prefetch` > 0 double-buffers the
+        sweep: shard loads run `prefetch` ahead on a background reader
+        thread (read_ahead above), with the mmap'd vector file materialized
+        READER-SIDE — np.load(mmap_mode='r') defers the actual disk read to
+        first touch, which without the copy would land back on the consumer
+        and overlap nothing."""
         # one merged-table build for the whole sweep (not one per shard)
-        for s in self.shards():
-            yield self._load_entry(s, raw=raw)
+        entries = self.shards()
+        if not prefetch:
+            return (self._load_entry(s, raw=raw) for s in entries)
+
+        def _load():
+            for s in entries:
+                out = self._load_entry(s, raw=raw)
+                yield (out[0], np.asarray(out[1]), *out[2:])
+
+        return read_ahead(_load(), depth=prefetch)

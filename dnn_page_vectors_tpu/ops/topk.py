@@ -185,12 +185,25 @@ def merge_topk_host(best_s: np.ndarray, best_i: np.ndarray,
                     new_s: np.ndarray, new_i: np.ndarray
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Host-side running-top-k merge of two [Nq, k] candidate sets (ids are
-    global page ids; -1 = empty slot)."""
+    global page ids; -1 = empty slot).
+
+    O(W) argpartition down to the winning k, then an O(k log k) sort of
+    just those — not a full-row argsort: this runs once per disk shard per
+    query-batch on the streaming path, so at 1B-page scale it is the
+    hottest host loop serving owns. Ties at the selection boundary may
+    admit a different equal-scored candidate than a stable full sort would
+    (scores are unchanged; only which of the tied ids survives)."""
     k = best_s.shape[1]
     cat_s = np.concatenate([best_s, new_s], axis=1)
     cat_i = np.concatenate([best_i, new_i], axis=1)
     cat_s = np.where(cat_i < 0, -np.inf, cat_s)
-    pos = np.argsort(-cat_s, axis=1, kind="stable")[:, :k]
+    if cat_s.shape[1] > k:
+        part = np.argpartition(-cat_s, k - 1, axis=1)[:, :k]
+        order = np.argsort(-np.take_along_axis(cat_s, part, axis=1),
+                           axis=1, kind="stable")
+        pos = np.take_along_axis(part, order, axis=1)
+    else:
+        pos = np.argsort(-cat_s, axis=1, kind="stable")
     return (np.take_along_axis(cat_s, pos, axis=1),
             np.take_along_axis(cat_i, pos, axis=1))
 
@@ -244,7 +257,10 @@ def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
     time, merging a host-side running top-k. Returns (scores [Nq, k],
     page_ids [Nq, k] int64, -1 padded). This is the cross-shard merge path
     for 1B-page retrieval: peak HBM = one store shard / n_data per device,
-    peak host memory = one store shard + the query matrix.
+    peak host memory = TWO store shards + the query matrix — the sweep is
+    double-buffered (store.iter_shards(prefetch=1)): shard i+1's disk read
+    runs on a background reader thread while shard i is staged and scored,
+    so disk latency overlaps device top-k instead of serializing after it.
     """
     nq, dim = query_vecs.shape
     n_data = mesh.shape["data"]
@@ -256,7 +272,7 @@ def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
     shard_rows = max((s["count"] for s in store.shards()), default=0)
     shard_rows += (-shard_rows) % max(n_data, 1)
     qb = min(query_batch, nq)
-    for ids, vecs, scl in store.iter_shards(raw=True):
+    for ids, vecs, scl in store.iter_shards(raw=True, prefetch=1):
         n = vecs.shape[0]
         if n == 0:        # empty shard: nothing to score, don't stage it
             continue

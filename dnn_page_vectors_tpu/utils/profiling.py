@@ -27,6 +27,15 @@ class PipelineProfiler:
       write         shard concat + write_shard on the writer thread
       write_wait    device loop blocked on the bounded writeback budget
 
+    The serving path (infer/serve.py) uses:
+      queue_wait    request sat in the micro-batcher queue before dispatch
+      tokenize      encode_batch over the coalesced cache-miss queries
+      encode        compiled query-tower dispatch (+ host materialize)
+      topk          per-shard sharded_topk dispatches (or the streaming
+                    sweep on a non-resident store)
+      merge         device cross-shard merge + the one packed transfer
+      format        page-id mapping + snippet assembly
+
     Seconds are CUMULATIVE ACROSS THREADS — a pool of N tokenizer workers
     adds each worker's time, so `read`/`tokenize` can exceed wall clock.
     That is the point: the ratios between stages (and the consumer-side
@@ -73,6 +82,55 @@ class PipelineProfiler:
         with self._lock:
             return {f"{prefix}{k}_s": round(v, 4)
                     for k, v in sorted(self._sec.items())}
+
+
+class LatencyStats:
+    """Per-request latency samples -> distribution numbers (count, mean,
+    p50/p99). PipelineProfiler answers "which stage binds" with cumulative
+    seconds; this answers the serving question it can't — what one caller
+    experiences under load, where the tail (p99) matters more than the
+    mean. Thread-safe: concurrent search() callers add into one instance.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._s: list = []
+
+    def add(self, seconds: float) -> None:
+        with self._lock:
+            self._s.append(float(seconds))
+
+    @contextlib.contextmanager
+    def timed(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(time.perf_counter() - t0)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._s)
+
+    def percentile_ms(self, q: float) -> float:
+        """Nearest-rank percentile (q in [0, 100]) in milliseconds; 0.0
+        with no samples. p50 of an even count is the lower middle sample —
+        a latency the service actually delivered, not an interpolation."""
+        with self._lock:
+            if not self._s:
+                return 0.0
+            s = sorted(self._s)
+        rank = max(0, min(len(s) - 1, int(-(-q * len(s) // 100)) - 1))
+        return s[rank] * 1000.0
+
+    def summary(self, prefix: str = "lat_") -> Dict[str, float]:
+        with self._lock:
+            n = len(self._s)
+            mean = sum(self._s) / n if n else 0.0
+        return {f"{prefix}count": n,
+                f"{prefix}mean_ms": round(mean * 1000.0, 3),
+                f"{prefix}p50_ms": round(self.percentile_ms(50), 3),
+                f"{prefix}p99_ms": round(self.percentile_ms(99), 3)}
 
 
 @contextlib.contextmanager

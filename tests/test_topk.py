@@ -1,9 +1,11 @@
-"""Unit tests: chunked / sharded / store-streaming top-k vs numpy reference."""
+"""Unit tests: chunked / sharded / store-streaming top-k vs numpy reference,
+the argpartition host merge, and the double-buffered shard read-ahead."""
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dnn_page_vectors_tpu.ops.topk import (
-    chunked_topk, sharded_topk, topk_over_store)
+    chunked_topk, merge_topk_host, sharded_topk, topk_over_store)
 from dnn_page_vectors_tpu.parallel.mesh import make_mesh
 from dnn_page_vectors_tpu.config import MeshConfig
 
@@ -87,6 +89,85 @@ def test_chunked_topk_small_corpus():
     assert (i[:, :3] >= 0).all()
     assert (i[:, 3:] == -1).all()
     assert np.isinf(s[:, 3:]).all()
+
+
+def test_merge_topk_host_partition_matches_full_sort():
+    """The O(W) argpartition merge must select exactly the scores a full
+    stable argsort selects (ids may differ only on exact ties), keep the
+    row sorted descending, and keep -1 empty slots masked to -inf."""
+    rng = np.random.default_rng(11)
+    for nq, k in ((1, 1), (4, 10), (33, 7)):
+        best_s = rng.normal(size=(nq, k)).astype(np.float32)
+        best_i = rng.integers(0, 10_000, size=(nq, k)).astype(np.int64)
+        new_s = rng.normal(size=(nq, k)).astype(np.float32)
+        new_i = rng.integers(0, 10_000, size=(nq, k)).astype(np.int64)
+        # empty slots (running merge mid-sweep) must never win
+        best_i[:, -1] = -1
+        new_i[0, 0] = -1
+        ms, mi = merge_topk_host(best_s, best_i, new_s, new_i)
+        cat_s = np.concatenate([best_s, new_s], axis=1)
+        cat_i = np.concatenate([best_i, new_i], axis=1)
+        cat_s = np.where(cat_i < 0, -np.inf, cat_s)
+        ref = np.take_along_axis(
+            cat_s, np.argsort(-cat_s, axis=1, kind="stable")[:, :k], axis=1)
+        np.testing.assert_array_equal(ms, ref)
+        assert (ms[:, :-1] >= ms[:, 1:]).all()
+        assert (mi[np.isneginf(ms)] == -1).all() if np.isneginf(ms).any() \
+            else True
+        # every surviving id scores what the merge says it scores
+        lookup = {}
+        for r in range(nq):
+            lookup.clear()
+            for s, i in zip(cat_s[r], cat_i[r]):
+                if i >= 0:
+                    lookup.setdefault(int(i), set()).add(float(s))
+            for s, i in zip(ms[r], mi[r]):
+                if i >= 0:
+                    assert float(s) in lookup[int(i)]
+
+
+def test_read_ahead_order_and_error_propagation():
+    from dnn_page_vectors_tpu.infer.vector_store import read_ahead
+
+    assert list(read_ahead(iter(range(20)), depth=1)) == list(range(20))
+    assert list(read_ahead(iter([]), depth=2)) == []
+
+    def _boom():
+        yield 1
+        yield 2
+        raise IOError("disk died mid-sweep")
+
+    it = read_ahead(_boom(), depth=1)
+    got = []
+    with pytest.raises(IOError, match="disk died"):
+        for x in it:
+            got.append(x)
+    assert got == [1, 2]    # items before the fault are delivered in order
+    # an abandoning consumer must not deadlock against a blocked reader
+    it = read_ahead(iter(range(1000)), depth=1)
+    assert next(it) == 0
+    it.close()
+
+
+def test_topk_over_store_read_fault_reraises(eight_devices, tmp_path):
+    """The prefetched sweep keeps the serial exception surface: a shard
+    read failing on the reader thread re-raises at the consumer."""
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    from dnn_page_vectors_tpu.utils import faults
+
+    mesh = make_mesh(MeshConfig(data=8))
+    rng = np.random.default_rng(5)
+    vecs = rng.normal(size=(64, 16)).astype(np.float32)
+    store = VectorStore(str(tmp_path / "store"), dim=16, shard_size=32)
+    store.write_shard(0, np.arange(32), vecs[:32])
+    store.write_shard(1, np.arange(32, 64), vecs[32:])
+    q = rng.normal(size=(3, 16)).astype(np.float32)
+    faults.install(faults.FaultPlan.parse("shard_read:io_error:1", seed=0))
+    try:
+        with pytest.raises(IOError):
+            topk_over_store(q, store, mesh, k=5, chunk=16)
+    finally:
+        faults.reset()
 
 
 def test_topk_over_store_skips_empty_shard(eight_devices, tmp_path):
