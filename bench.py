@@ -346,6 +346,72 @@ def run_worker() -> None:
                     key: round(val, 3)
                     for key, val in sorted(sprof.stages().items())},
             })
+
+            # ---- ann sub-phase: IVF index over the same >=50k store ----
+            # Build the inverted file (TPU k-means), measure index quality
+            # (recall@10 of the exact top-10 at the default nprobe) and
+            # ANN serving QPS under the IDENTICAL protocol as serve_qps
+            # (same store, queries, concurrency, batcher, cache) — so
+            # ann_qps / serve_qps isolates the retrieval algorithm.
+            # Skippable via BENCH_ANN=0.
+            if os.environ.get("BENCH_ANN", "1") != "0":
+                try:
+                    import dataclasses as _dc
+
+                    import numpy as _np3
+
+                    from dnn_page_vectors_tpu.evals.recall import (
+                        recall_vs_exact)
+                    from dnn_page_vectors_tpu.index.ivf import IVFIndex
+                    _stamp(f"ann phase: building IVF index over "
+                           f"{sstore.num_vectors} vectors")
+                    t0 = time.perf_counter()
+                    aidx = IVFIndex.build(sstore, embedder.mesh,
+                                          nlist=cfg.serve.nlist,
+                                          iters=cfg.serve.kmeans_iters,
+                                          seed=0)
+                    build_s = time.perf_counter() - t0
+                    qv = _np3.asarray(
+                        embedder.embed_texts(qtexts, tower="query"),
+                        _np3.float32)
+                    r10 = recall_vs_exact(aidx, sstore, qv, embedder.mesh,
+                                          k=10, nprobe=cfg.serve.nprobe)
+                    _stamp(f"ann index built ({build_s:.1f}s, nlist="
+                           f"{aidx.nlist}); recall@10 vs exact {r10:.3f}; "
+                           f"timing {n_q}@{conc} batched")
+                    acfg = cfg.replace(serve=_dc.replace(cfg.serve,
+                                                         index="ivf"))
+                    asvc = SearchService(acfg, embedder, trainer.corpus,
+                                         sstore, preload_hbm_gb=0.0)
+                    asvc.warmup(k=kq)
+                    asvc.clear_cache()
+                    asvc.start_batcher()
+                    t0 = time.perf_counter()
+                    with concurrent.futures.ThreadPoolExecutor(conc) as ex:
+                        list(ex.map(
+                            lambda i: asvc.search(qtexts[i % distinct],
+                                                  k=kq), range(n_q)))
+                    adt = time.perf_counter() - t0
+                    asvc.close()
+                    amet = asvc.metrics()
+                    rec.update({
+                        "ann_recall_at_10": round(r10, 4),
+                        "ann_qps": round(n_q / adt, 2),
+                        "ann_build_seconds": round(build_s, 3),
+                        "ann_nlist": aidx.nlist,
+                        "ann_nprobe": cfg.serve.nprobe,
+                        "ann_imbalance": aidx.imbalance,
+                        "ann_fallbacks": amet.get("ann_fallbacks", 0),
+                        "ann_lists_scanned": amet.get(
+                            "ann_lists_scanned", 0),
+                        "ann_candidates_reranked": amet.get(
+                            "ann_candidates_reranked", 0),
+                        "ann_vs_exact_qps": round(
+                            (n_q / adt) / max(rec.get("serve_qps") or 1e-9,
+                                              1e-9), 3),
+                    })
+                except Exception as e:  # ann failure must keep serve data
+                    rec["ann_error"] = f"{type(e).__name__}: {e}"[:300]
         except Exception as e:  # optional phase must never cost the round
             rec["serve_error"] = f"{type(e).__name__}: {e}"[:300]
         print(json.dumps(rec), flush=True)

@@ -6,6 +6,8 @@
   python -m dnn_page_vectors_tpu.cli mine  --config hardneg_v5p64
   python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --query "..."
   python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --queries q.txt
+  python -m dnn_page_vectors_tpu.cli index --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --nprobe 8 ...
   python -m dnn_page_vectors_tpu.cli pipeline --config hardneg_v5p64 --rounds 4
 
 Any config field is overridable with --set section.field=value; every flag
@@ -39,6 +41,21 @@ def _prepare_store(store_dir, cfg, model_step):
     return prepare_store(store_dir, cfg.model.out_dim,
                          cfg.eval.store_shard_size, cfg.eval.store_dtype,
                          model_step)
+
+
+def _open_index(cfg, store):
+    """The IVF index for eval/mine when serve.index=ivf, or None (exact
+    path) — unavailability warns and falls back rather than failing the
+    command (docs/ANN.md)."""
+    if cfg.serve.index != "ivf":
+        return None
+    from dnn_page_vectors_tpu.index.ivf import IndexUnavailable, IVFIndex
+    from dnn_page_vectors_tpu.utils import faults as _faults
+    try:
+        return IVFIndex.open(store)
+    except IndexUnavailable as e:
+        _faults.warn(f"IVF index unavailable ({e}); using exact retrieval")
+        return None
 
 
 def _trainer(cfg):
@@ -85,7 +102,7 @@ def main(argv=None) -> None:
     ap.add_argument("command", choices=["train", "embed", "eval", "mine",
                                         "search", "pipeline", "configs",
                                         "init-store", "merge-store",
-                                        "reset-store"])
+                                        "reset-store", "index"])
     ap.add_argument("--query", default=None,
                     help="search: free-text query to embed and retrieve for")
     ap.add_argument("--queries", default=None, metavar="FILE",
@@ -97,6 +114,10 @@ def main(argv=None) -> None:
                          "line each (model + store loaded once)")
     ap.add_argument("--topk", type=int, default=None,
                     help="search: results to return (default eval.recall_k)")
+    ap.add_argument("--nprobe", type=int, default=None,
+                    help="search/eval/mine: IVF lists probed per query — "
+                         "implies serve.index=ivf (docs/ANN.md; shorthand "
+                         "for --set serve.index=ivf --set serve.nprobe=N)")
     ap.add_argument("--rounds", type=int, default=2,
                     help="pipeline: train->embed->mine->train rounds")
     ap.add_argument("--config", default="cdssm_toy", choices=sorted(CONFIGS))
@@ -132,6 +153,10 @@ def main(argv=None) -> None:
     if args.faults is not None:
         import dataclasses as _dc
         cfg = cfg.replace(faults=_dc.replace(cfg.faults, plan=args.faults))
+    if args.nprobe is not None:
+        import dataclasses as _dc
+        cfg = cfg.replace(serve=_dc.replace(cfg.serve, index="ivf",
+                                            nprobe=args.nprobe))
 
     # fault injection (only when a plan is configured) + the always-on
     # transient-I/O retry policy — every command goes through this
@@ -171,6 +196,29 @@ def main(argv=None) -> None:
         print(json.dumps({"store": store_dir,
                           "shards": len(store.manifest["shards"]),
                           "vectors": store.num_vectors}))
+        return
+
+    if args.command == "index":
+        # Build/rebuild the IVF ANN index over an embedded store
+        # (docs/ANN.md). Needs no model or tokenizer — just the store and
+        # a device mesh for the MXU k-means; an existing index is
+        # overwritten (build is deterministic for a given store + seed).
+        import time as _time
+
+        from dnn_page_vectors_tpu.index.ivf import IVFIndex
+        from dnn_page_vectors_tpu.parallel.multihost import local_mesh
+        store = VectorStore(store_dir)
+        t0 = _time.perf_counter()
+        idx = IVFIndex.build(store, local_mesh(cfg.mesh),
+                             nlist=cfg.serve.nlist,
+                             iters=cfg.serve.kmeans_iters,
+                             seed=cfg.data.seed)
+        print(json.dumps({
+            "store": store_dir, "vectors": store.num_vectors,
+            "nlist": idx.nlist, "imbalance": idx.imbalance,
+            "model_step": idx.model_step,
+            "build_seconds": round(_time.perf_counter() - t0, 3),
+            "fault_counters": faults.counters()}, sort_keys=True))
         return
 
     if args.command == "init-store":
@@ -287,11 +335,15 @@ def main(argv=None) -> None:
     elif args.command == "eval":
         from dnn_page_vectors_tpu.evals.recall import evaluate_recall
         store = VectorStore(store_dir)
+        index = _open_index(cfg, store)
         recall, nq = evaluate_recall(embedder, trainer.corpus, store,
-                                     k=cfg.eval.recall_k)
+                                     k=cfg.eval.recall_k, index=index,
+                                     nprobe=cfg.serve.nprobe)
         if pi == 0:
             print(json.dumps({f"recall@{cfg.eval.recall_k}": recall,
-                              "num_queries": nq}, sort_keys=True))
+                              "num_queries": nq,
+                              "index": ("ivf" if index is not None
+                                        else "exact")}, sort_keys=True))
     elif args.command == "search":
         # query-time retrieval over the embedded store (the serving half of
         # call stack §4.3): SearchService loads everything once — params on
@@ -357,14 +409,18 @@ def main(argv=None) -> None:
     elif args.command == "mine":
         from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives
         store = VectorStore(store_dir)
+        index = _open_index(cfg, store)
         out = os.path.join(cfg.workdir, "hard_negatives.npy")
         # out_path at any process count: the miner's writer-slice protocol
         # keeps peak host memory O(query_block) and barriers internally
         negs = mine_hard_negatives(embedder, trainer.corpus, store,
                                    num_negatives=cfg.train.hard_negatives or 7,
-                                   out_path=out)
+                                   out_path=out, index=index,
+                                   nprobe=cfg.serve.nprobe)
         if pi == 0:
-            print(json.dumps({"mined": list(negs.table.shape), "path": out}))
+            print(json.dumps({"mined": list(negs.table.shape), "path": out,
+                              "index": ("ivf" if index is not None
+                                        else "exact")}))
 
 
 if __name__ == "__main__":

@@ -167,6 +167,20 @@ class ServeConfig:
     # head-of-distribution repeat queries skip tokenize+encode entirely
     # and a model/store reload (new step) invalidates every entry.
     query_cache_size: int = 4096
+    # Retrieval algorithm (docs/ANN.md): "exact" = brute-force MXU top-k
+    # over the whole store (byte-identical pre-index behavior, the
+    # default); "ivf" = the inverted-file ANN index (index/ivf.py) with
+    # automatic per-request fallback to exact when the index is missing,
+    # stale, or quarantined (counted in metrics as ann_fallbacks).
+    index: str = "exact"
+    # IVF lists probed per query: the recall-vs-cost dial. Expected scanned
+    # fraction ~ nprobe/nlist; recall-vs-exact is measured, not assumed
+    # (evals.recall.recall_vs_exact, bench ann_recall_at_10).
+    nprobe: int = 8
+    # IVF list count for `cli index` builds. 0 = auto (~sqrt(store rows)).
+    nlist: int = 0
+    # k-means iterations for the IVF coarse quantizer build.
+    kmeans_iters: int = 8
 
 
 @dataclasses.dataclass(frozen=True)

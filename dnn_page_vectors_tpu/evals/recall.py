@@ -47,29 +47,66 @@ def recall_at_k(query_vecs: np.ndarray, page_ids: np.ndarray,
 
 def hits_from_store(query_vecs: np.ndarray, store: VectorStore,
                     gold_ids: np.ndarray, mesh, k: int = 10,
-                    query_batch: int = 1024, chunk: int = 8192) -> int:
-    """Number of queries whose gold id lands in the store-streamed top-k."""
+                    query_batch: int = 1024, chunk: int = 8192,
+                    index=None, nprobe: Optional[int] = None) -> int:
+    """Number of queries whose gold id lands in the store-streamed top-k.
+    With `index` (index.ivf.IVFIndex), retrieval goes through the
+    sublinear ANN path instead of the full-store sweep (docs/ANN.md) —
+    the reported recall then measures model AND index quality together."""
     if query_vecs.shape[0] == 0:
         return 0
-    _, retrieved = topk_over_store(
-        np.asarray(query_vecs, np.float32), store, mesh, k=k,
-        chunk=chunk, query_batch=query_batch)
+    if index is not None:
+        _, retrieved, _ = index.search(
+            np.asarray(query_vecs, np.float32), k=k, nprobe=nprobe)
+    else:
+        _, retrieved = topk_over_store(
+            np.asarray(query_vecs, np.float32), store, mesh, k=k,
+            chunk=chunk, query_batch=query_batch)
     return int((retrieved == gold_ids[:, None]).any(axis=1).sum())
 
 
 def recall_from_store(query_vecs: np.ndarray, store: VectorStore,
                       gold_ids: np.ndarray, mesh, k: int = 10,
-                      query_batch: int = 1024, chunk: int = 8192) -> float:
+                      query_batch: int = 1024, chunk: int = 8192,
+                      index=None, nprobe: Optional[int] = None) -> float:
     """Recall@k streaming the store through the sharded cross-shard merge —
-    never materializes more than one store shard."""
+    never materializes more than one store shard. `index`/`nprobe` route
+    retrieval through the IVF ANN path instead (hits_from_store)."""
     hits = hits_from_store(query_vecs, store, gold_ids, mesh, k=k,
-                           query_batch=query_batch, chunk=chunk)
+                           query_batch=query_batch, chunk=chunk,
+                           index=index, nprobe=nprobe)
     return float(hits) / max(query_vecs.shape[0], 1)
+
+
+def recall_vs_exact(index, store: VectorStore, query_vecs: np.ndarray,
+                    mesh, k: int = 10, nprobe: Optional[int] = None,
+                    query_batch: int = 1024, chunk: int = 8192) -> float:
+    """ANN recall@k against the EXACT ground truth: the mean fraction of
+    each query's exact top-k (topk_over_store) that the IVF index also
+    returns at this `nprobe`. This is the index-quality contract
+    (docs/ANN.md) — independent of model quality, unlike gold-id recall —
+    and lands in the bench record as `ann_recall_at_10`."""
+    qv = np.asarray(query_vecs, np.float32)
+    if qv.shape[0] == 0:
+        return 0.0
+    _, exact_ids = topk_over_store(qv, store, mesh, k=k, chunk=chunk,
+                                   query_batch=query_batch)
+    _, ann_ids, _ = index.search(qv, k=k, nprobe=nprobe)
+    total = 0.0
+    for row_exact, row_ann in zip(exact_ids, ann_ids):
+        truth = set(int(i) for i in row_exact if i >= 0)
+        if not truth:
+            total += 1.0
+            continue
+        got = set(int(i) for i in row_ann if i >= 0)
+        total += len(truth & got) / len(truth)
+    return total / qv.shape[0]
 
 
 def evaluate_recall(embedder: BulkEmbedder, corpus: ToyCorpus,
                     store: VectorStore, num_queries: Optional[int] = None,
-                    k: int = 10) -> Tuple[float, int]:
+                    k: int = 10, index=None,
+                    nprobe: Optional[int] = None) -> Tuple[float, int]:
     """Embed eval queries, search the store, return (recall@k, num_queries).
     Gold label for query i is page i (ToyCorpus invariant).
 
@@ -85,7 +122,8 @@ def evaluate_recall(embedder: BulkEmbedder, corpus: ToyCorpus,
     query_vecs = embedder.embed_texts(
         [corpus.query_text(i) for i in range(lo, hi)], tower="query")
     gold = np.arange(lo, hi, dtype=np.int64)
-    hits = hits_from_store(query_vecs, store, gold, embedder.mesh, k=k)
+    hits = hits_from_store(query_vecs, store, gold, embedder.mesh, k=k,
+                           index=index, nprobe=nprobe)
     if pc > 1:
         counts = allgather_hosts(np.array([hits, hi - lo], np.int64)).sum(0)
         return float(counts[0]) / max(int(counts[1]), 1), nq

@@ -1,0 +1,11 @@
+"""IVF ANN index subsystem (docs/ANN.md).
+
+`kmeans.py` trains the coarse quantizer (nlist centroids) on the MXU by
+streaming vector-store shards through the mesh; `ivf.py` persists the
+inverted file next to the store and serves sublinear `search(q, k, nprobe)`
+with an exact on-device re-rank. Every retrieval caller (serve, eval, mine)
+falls back to the exact brute-force path (`ops/topk.py`) when the index is
+missing, stale, or quarantined.
+"""
+from dnn_page_vectors_tpu.index.ivf import IndexUnavailable, IVFIndex  # noqa: F401
+from dnn_page_vectors_tpu.index.kmeans import train_kmeans  # noqa: F401

@@ -1,0 +1,397 @@
+"""IVF inverted-file ANN index over the vector store (docs/ANN.md).
+
+Every retrieval path used to pay O(corpus) per query through
+`ops/topk.py:topk_over_store`. This index makes retrieval sublinear the
+canonical way (Jegou et al. 2011; Johnson et al. 2017 / faiss): a coarse
+k-means quantizer (index/kmeans.py, trained on the MXU over streamed store
+shards) partitions the store's rows into `nlist` inverted lists; a query
+scores the tiny [nlist, D] centroid matrix on device, gathers only the
+rows of its top-`nprobe` lists from the store's memory-mapped shards (int8
+codes at stored width — dequant fuses into the re-rank matmul), and
+exact-reranks that candidate block with `ops.topk.rerank_candidates`.
+Recall-vs-exact is a measured contract (`evals.recall.recall_vs_exact`,
+bench `ann_recall_at_10`), not a hope.
+
+Layout (next to the store, same manifest machinery as VectorStore):
+
+  <store>/ivf/manifest.json     nlist, dim, model_step stamp, seed, per-file
+                                byte sizes + CRC32s, per-shard posting table
+  <store>/ivf/centroids.npy     [nlist, D] float32 unit-norm centroids
+  <store>/ivf/posting_NNNNN.ord.npy   [count] int32 shard-row order, grouped
+                                      by centroid (CSR values)
+  <store>/ivf/posting_NNNNN.off.npy   [nlist+1] int64 CSR offsets
+
+Validity contract (docs/ROBUSTNESS.md semantics): `open()` re-checks the
+recorded model step against the store's stamp, the recorded shard table
+(index, count) against the store's live one, and every file's bytes+CRC32.
+A stale index (ensure_model_step re-stamp, re-embed, shard quarantine)
+raises `IndexUnavailable`; a corrupt file is quarantined (renamed aside,
+counted in the fault counters) and the index reports unavailable — callers
+(SearchService, eval, mine) fall back to the exact brute-force path
+per request, visibly, and `cli index` rebuilds.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dnn_page_vectors_tpu.index.kmeans import assign_store, train_kmeans
+from dnn_page_vectors_tpu.infer.vector_store import crc_file
+from dnn_page_vectors_tpu.ops.topk import chunked_topk, rerank_candidates
+from dnn_page_vectors_tpu.utils import faults
+
+DIRNAME = "ivf"
+MANIFEST = "manifest.json"
+
+
+class IndexUnavailable(RuntimeError):
+    """The IVF index cannot serve (missing / stale / quarantined). Callers
+    catch this and fall back to exact search — it is a routing signal, not
+    a crash."""
+
+
+def index_dir(store) -> str:
+    return os.path.join(store.directory, DIRNAME)
+
+
+def auto_nlist(num_vectors: int) -> int:
+    """Default list count: ~sqrt(N) (the standard IVF operating point),
+    clamped so tiny toy stores still get a few multi-row lists and huge
+    stores don't pay a megarow centroid scan."""
+    return max(4, min(int(math.isqrt(max(num_vectors, 1))), 65_536,
+                      max(num_vectors, 1)))
+
+
+def _bucket(n: int, lo: int) -> int:
+    """Next power of two >= max(n, lo): one compiled shape per octave, so
+    varying candidate/query counts don't retrace every call."""
+    return 1 << max(int(math.ceil(math.log2(max(n, 1)))), int(lo - 1).bit_length())
+
+
+def _write_npy(path: str, arr: np.ndarray) -> Tuple[int, int]:
+    """Durable seeded-fault-aware array write (the write_shard pattern):
+    bytes land + fsync, size+CRC recorded from the written bytes, and the
+    post-fsync corruption hook fires AFTER the record — so injected rot is
+    caught by the verify gate, not hidden by the writer."""
+    plan = faults.active()
+
+    def _w():
+        plan.check("index_write")
+        np.save(path, arr)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    faults.retry(_w, op="index_write")
+    rec = (os.path.getsize(path), crc_file(path))
+    plan.corrupt("index_file", path)
+    return rec
+
+
+def _atomic_dump(obj, path: str) -> None:
+    plan = faults.active()
+
+    def _dump():
+        plan.check("index_write")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    faults.retry(_dump, op="index_write")
+
+
+class IVFIndex:
+    def __init__(self, store, manifest: Dict, centroids: np.ndarray,
+                 postings: Dict[int, Tuple[np.ndarray, np.ndarray]]):
+        self.store = store
+        self.manifest = manifest
+        self.centroids = centroids                 # [nlist, D] f32
+        self._postings = postings                  # {shard: (order, offsets)}
+        self._entries = {s["index"]: s for s in store.shards()}
+        self._raw: Dict[int, tuple] = {}           # lazy mmap cache
+        self._dev_centroids = None
+        # total rows per list across shards: candidate accounting without
+        # touching the postings at search time
+        sizes = np.zeros((self.nlist,), np.int64)
+        for _, offsets in postings.values():
+            sizes += np.diff(offsets)
+        self.list_sizes = sizes
+        self.stats = {"searches": 0, "lists_scanned": 0,
+                      "candidates_reranked": 0}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def nlist(self) -> int:
+        return int(self.manifest["nlist"])
+
+    @property
+    def model_step(self) -> Optional[int]:
+        return self.manifest.get("model_step")
+
+    @property
+    def imbalance(self) -> float:
+        return float(self.manifest.get("imbalance", 0.0))
+
+    # -- build -------------------------------------------------------------
+    @classmethod
+    def build(cls, store, mesh, nlist: int = 0, iters: int = 8,
+              seed: int = 0, chunk: int = 8192,
+              sample_per_shard: Optional[int] = None) -> "IVFIndex":
+        """Train the quantizer, assign every store row, and persist the
+        inverted file next to the store (atomic manifest last, so a crash
+        mid-build leaves the previous index or none — never a torn one
+        that passes verification)."""
+        t0 = time.perf_counter()
+        N = store.num_vectors
+        if N == 0:
+            raise ValueError("cannot build an IVF index over an empty store")
+        nlist = int(nlist) if nlist and nlist > 0 else auto_nlist(N)
+        nlist = min(nlist, N)
+        centroids, kstats = train_kmeans(
+            store, mesh, nlist, iters=iters, seed=seed, chunk=chunk,
+            sample_per_shard=sample_per_shard)
+        d = index_dir(store)
+        os.makedirs(d, exist_ok=True)
+        cb, cc = _write_npy(os.path.join(d, "centroids.npy"), centroids)
+        shards_meta = []
+        postings: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        sizes = np.zeros((nlist,), np.int64)
+        for entry, assign in assign_store(store, mesh, centroids,
+                                          chunk=chunk):
+            order = np.argsort(assign, kind="stable").astype(np.int32)
+            counts = np.bincount(assign, minlength=nlist)
+            offsets = np.zeros((nlist + 1,), np.int64)
+            offsets[1:] = np.cumsum(counts)
+            sizes += counts
+            stem = f"posting_{entry['index']:05d}"
+            ob, oc = _write_npy(os.path.join(d, stem + ".ord.npy"), order)
+            fb, fc = _write_npy(os.path.join(d, stem + ".off.npy"), offsets)
+            shards_meta.append({
+                "index": entry["index"], "count": int(entry["count"]),
+                "ord": stem + ".ord.npy", "off": stem + ".off.npy",
+                "bytes": {"ord": ob, "off": fb},
+                "crc": {"ord": oc, "off": fc}})
+            postings[entry["index"]] = (order, offsets)
+        # zero-count shards carry no postings but must stay in the recorded
+        # table, or open() would read an honest store change into them
+        for entry in store.shards():
+            if entry["count"] == 0:
+                shards_meta.append({"index": entry["index"], "count": 0})
+        shards_meta.sort(key=lambda s: s["index"])
+        imbalance = float(nlist * np.square(sizes, dtype=np.float64).sum()
+                          / max(N, 1) ** 2)
+        manifest = {
+            "version": 1, "nlist": nlist, "dim": store.dim,
+            "dtype": store.manifest["dtype"],
+            "model_step": store.model_step, "seed": int(seed),
+            "iters": kstats["iters"], "reseeded": kstats["reseeded"],
+            "num_vectors": int(N), "imbalance": round(imbalance, 4),
+            "build_seconds": round(time.perf_counter() - t0, 3),
+            "centroids": {"file": "centroids.npy", "bytes": cb, "crc": cc},
+            "shards": shards_meta,
+        }
+        _atomic_dump(manifest, os.path.join(d, MANIFEST))
+        return cls(store, manifest, centroids, postings)
+
+    # -- open / verify -----------------------------------------------------
+    @classmethod
+    def open(cls, store, verify: bool = True) -> "IVFIndex":
+        """Load the persisted index, re-checking stamp, shard table, and
+        bytes+CRC32. Raises IndexUnavailable (with the reason) on any
+        mismatch — corrupt files are quarantined first."""
+        d = index_dir(store)
+        mpath = os.path.join(d, MANIFEST)
+        if not os.path.exists(mpath):
+            raise IndexUnavailable(
+                f"no IVF index at {d} (run the 'index' command to build)")
+        try:
+            with open(mpath) as f:
+                man = json.load(f)
+        except (json.JSONDecodeError, ValueError):
+            q = mpath + ".quarantined"
+            os.replace(mpath, q)
+            faults.count("quarantined_index_manifests")
+            faults.warn(f"IVF manifest {mpath} is torn (invalid JSON); "
+                        f"moved aside to {q}")
+            raise IndexUnavailable(f"torn IVF manifest (quarantined to {q})")
+        if man.get("model_step") != store.model_step:
+            raise IndexUnavailable(
+                f"stale IVF index: built at model step "
+                f"{man.get('model_step')}, store is stamped "
+                f"{store.model_step} (rebuild after re-embedding)")
+        if man.get("dim") != store.dim:
+            raise IndexUnavailable(
+                f"stale IVF index: built for {man.get('dim')}-d vectors, "
+                f"store holds {store.dim}-d")
+        live = {s["index"]: s["count"] for s in store.shards()}
+        recorded = {s["index"]: s["count"] for s in man.get("shards", [])}
+        if live != recorded:
+            raise IndexUnavailable(
+                "stale IVF index: store shard table changed since the "
+                f"build ({len(recorded)} recorded vs {len(live)} live "
+                "shards or row counts differ); rebuild")
+        if verify:
+            cls._verify_files(d, man)
+        plan = faults.active()
+        centroids = np.load(os.path.join(d, man["centroids"]["file"]))
+        postings: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for s in man["shards"]:
+            if s["count"] == 0:
+                continue
+            plan.check("index_read")
+            postings[s["index"]] = (
+                np.load(os.path.join(d, s["ord"])),
+                np.load(os.path.join(d, s["off"])))
+        return cls(store, man, np.asarray(centroids, np.float32), postings)
+
+    @staticmethod
+    def _verify_files(d: str, man: Dict) -> None:
+        files = [(man["centroids"]["file"], man["centroids"]["bytes"],
+                  man["centroids"]["crc"])]
+        for s in man["shards"]:
+            if s["count"] == 0:
+                continue
+            for key in ("ord", "off"):
+                files.append((s[key], s["bytes"][key], s["crc"][key]))
+        for name, want_bytes, want_crc in files:
+            path = os.path.join(d, name)
+            err = None
+            if not os.path.exists(path):
+                err = "missing"
+            elif os.path.getsize(path) != want_bytes:
+                err = (f"{os.path.getsize(path)} bytes, manifest records "
+                       f"{want_bytes} (truncated?)")
+            elif crc_file(path) != want_crc:
+                err = "CRC mismatch (corrupt)"
+            if err is None:
+                continue
+            if err != "missing":
+                os.replace(path, path + ".quarantined")
+                faults.count("quarantined_index_files")
+                faults.warn(f"quarantined IVF index file {path} ({err}); "
+                            "exact search serves until a rebuild")
+            raise IndexUnavailable(
+                f"IVF index file {name} {err}; rebuild the index")
+
+    # -- search ------------------------------------------------------------
+    def _shard_raw(self, sidx: int):
+        raw = self._raw.get(sidx)
+        if raw is None:
+            raw = self._raw[sidx] = self.store._load_entry(
+                self._entries[sidx], raw=True)
+        return raw
+
+    def _gather(self, cents: np.ndarray):
+        """Candidate block for one probed-list union: rows of every listed
+        centroid across every shard, at STORED width (int8 codes / fp16
+        rows straight off the mmap — the rerank matmul widens on device).
+        Returns (vecs [C, D], scales [C]|None, page_ids [C] i64,
+        cand_cent [C] i32)."""
+        v_parts, s_parts, i_parts, c_parts = [], [], [], []
+        for sidx in sorted(self._postings):
+            order, offsets = self._postings[sidx]
+            rows = [order[offsets[c]: offsets[c + 1]] for c in cents]
+            lens = np.array([r.shape[0] for r in rows], np.int64)
+            if lens.sum() == 0:
+                continue
+            take = np.concatenate(rows)
+            ids, vecs, scl = self._shard_raw(sidx)
+            v_parts.append(np.asarray(vecs[take]))
+            i_parts.append(np.asarray(ids[take], np.int64))
+            if scl is not None:
+                s_parts.append(np.asarray(scl[take]))
+            c_parts.append(np.repeat(cents.astype(np.int32), lens))
+        if not v_parts:
+            return (np.zeros((0, self.store.dim), np.float16), None,
+                    np.zeros((0,), np.int64), np.zeros((0,), np.int32))
+        return (np.concatenate(v_parts),
+                np.concatenate(s_parts) if s_parts else None,
+                np.concatenate(i_parts), np.concatenate(c_parts))
+
+    def search(self, qvecs: np.ndarray, k: int, nprobe: Optional[int] = None,
+               block: int = 256
+               ) -> Tuple[np.ndarray, np.ndarray, Dict[str, int]]:
+        """ANN top-k: (scores [Nq, k] f32, page_ids [Nq, k] i64 -1-padded,
+        stats). Centroid scoring runs on device through `chunked_topk`
+        (queries padded to a power-of-two bucket, one compiled program per
+        octave); queries are then processed in `block`-sized sub-blocks —
+        per sub-block ONE gathered candidate matmul via
+        `rerank_candidates`, dispatched async so sub-block i+1's host
+        gather overlaps sub-block i's device re-rank."""
+        qvecs = np.asarray(qvecs, np.float32)
+        nq = qvecs.shape[0]
+        k = int(k)
+        out_s = np.full((nq, k), -np.inf, np.float32)
+        out_i = np.full((nq, k), -1, np.int64)
+        if nq == 0:
+            return out_s, out_i, {}
+        nprobe = int(min(max(1, nprobe or 1), self.nlist))
+        if self._dev_centroids is None:
+            self._dev_centroids = jnp.asarray(self.centroids)
+        qb = _bucket(nq, lo=8)
+        qpad = np.concatenate(
+            [qvecs, np.zeros((qb - nq, qvecs.shape[1]), np.float32)]) \
+            if qb > nq else qvecs
+        _, sel = chunked_topk(jnp.asarray(qpad), self._dev_centroids,
+                              k=nprobe, chunk=8192)
+        sel = np.asarray(sel, np.int32)[:nq]
+        stats = {"searches": nq, "lists_scanned": nq * nprobe,
+                 "candidates_reranked":
+                     int(self.list_sizes[sel].sum())}
+        pending = []
+        for s in range(0, nq, block):
+            e = min(s + block, nq)
+            sel_b = sel[s:e]
+            cents = np.unique(sel_b)
+            cand, scl, cids, ccent = self._gather(cents)
+            C = cand.shape[0]
+            if C == 0:
+                pending.append((s, e, None, None))
+                continue
+            cp = _bucket(C, lo=max(512, k))
+            if cp > C:
+                cand = np.concatenate(
+                    [cand, np.zeros((cp - C, cand.shape[1]), cand.dtype)])
+                ccent = np.concatenate(
+                    [ccent, np.full((cp - C,), -1, np.int32)])
+                if scl is not None:
+                    scl = np.concatenate(
+                        [scl, np.zeros((cp - C,), scl.dtype)])
+            # pow-2 query bucket: a lone serve bucket of 8 must not pad to
+            # the full mining block width (32x wasted matmul rows)
+            bq = min(_bucket(e - s, lo=8), _bucket(block, lo=8))
+            qblk = qvecs[s:e]
+            if bq > e - s:
+                qblk = np.concatenate(
+                    [qblk, np.zeros((bq - (e - s), qvecs.shape[1]),
+                                    np.float32)])
+                sel_b = np.concatenate(
+                    [sel_b, np.full((bq - (e - s), nprobe), -1, np.int32)])
+            packed = rerank_candidates(
+                jnp.asarray(qblk), jnp.asarray(cand),
+                None if scl is None else jnp.asarray(scl),
+                jnp.asarray(ccent), jnp.asarray(sel_b), k)
+            pending.append((s, e, packed, cids))
+        for s, e, packed, cids in pending:
+            if packed is None:
+                continue
+            top_s, pos = (np.asarray(packed[0]), np.asarray(packed[1]))
+            top_s, pos = top_s[: e - s], pos[: e - s]
+            kk = pos.shape[1]
+            out_i[s:e, :kk] = np.where(
+                pos >= 0, cids[np.clip(pos, 0, None)], -1)
+            out_s[s:e, :kk] = np.where(pos >= 0, top_s, -np.inf)
+        for key, val in stats.items():
+            self.stats[key] = self.stats.get(key, 0) + val
+        return out_s, out_i, stats

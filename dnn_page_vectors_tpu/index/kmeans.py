@@ -1,0 +1,234 @@
+"""Sharded mini-batch k-means: the IVF coarse quantizer (docs/ANN.md).
+
+Trains `nlist` centroids over the vector store's L2-normalized rows with
+the SAME memory contract as `ops/topk.py:topk_over_store`: one disk shard
+at a time, row-sharded over the mesh 'data' axis, scored on the MXU. Each
+pass streams shards through a shard_mapped scan — per chunk, one
+[chunk, nlist] row-vs-centroid matmul picks assignments and one
+one-hot-transpose matmul accumulates per-centroid sums — then psums the
+[nlist, D] sums / [nlist] counts over ICI, so device memory never exceeds
+O(chunk * max(D, nlist)) per device and host memory never exceeds one
+shard plus the centroid matrix.
+
+Spherical k-means: store rows are unit-normalized (the store invariant, so
+retrieval is a pure dot product), and centroids are re-normalized after
+every update — assignment by max dot product IS cosine assignment, and the
+per-row int8 dequant scale factors out of the argmax entirely, so int8
+codes ship to the device at 1 B/dim and only the accumulation pass pays
+the widening.
+
+Determinism (test-pinned, tests/test_ivf_index.py): seeded init sample,
+seeded empty-cluster reseed, fixed shard/chunk reduction order — the same
+store + seed produces byte-identical centroids on the same backend.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dnn_page_vectors_tpu.ops.topk import stage_shard
+from dnn_page_vectors_tpu.utils.compat import (
+    pcast_varying, shard_map_unchecked)
+
+_PASS_CACHE: Dict[Tuple, object] = {}
+
+
+def _build_shard_pass(mesh: Mesh, nlist: int, chunk: int, scaled: bool):
+    """Jitted (rows[, scales], valid, centroids) -> (sums [nlist, D] f32,
+    counts [nlist] f32, assign [rows] i32) with rows row-sharded over
+    'data' and sums/counts psummed (replicated). Assignments come back in
+    global row order; padding rows (>= valid) carry assignment -1 and
+    contribute nothing to sums/counts."""
+
+    def run(rows_local, scales_local, valid, centroids):
+        rows = rows_local.shape[0]
+        shard = lax.axis_index("data")
+        valid_local = jnp.clip(valid - shard * rows, 0, rows).astype(jnp.int32)
+        c = min(chunk, rows)
+        pad = (-rows) % c
+        if pad:
+            rows_local = jnp.concatenate(
+                [rows_local,
+                 jnp.zeros((pad, rows_local.shape[1]), rows_local.dtype)])
+            if scales_local is not None:
+                scales_local = jnp.concatenate(
+                    [scales_local, jnp.zeros((pad,), scales_local.dtype)])
+        n_chunks = rows_local.shape[0] // c
+        blocks = rows_local.reshape(n_chunks, c, -1)
+        sblocks = (None if scales_local is None
+                   else scales_local.astype(jnp.float32).reshape(n_chunks, c))
+        D = centroids.shape[1]
+        # carry starts as a constant; pcast marks it varying over 'data' so
+        # the scan's in/out types agree under shard_map (see ops/topk.py)
+        init = jax.tree_util.tree_map(
+            lambda x: pcast_varying(x, ("data",)),
+            (jnp.zeros((nlist, D), jnp.float32),
+             jnp.zeros((nlist,), jnp.float32)))
+
+        def body(carry, inp):
+            sums, counts = carry
+            ci, block, scl = inp                         # block: [c, D]
+            rf = block.astype(jnp.float32)
+            if scl is not None:                          # int8 dequant
+                rf = rf * scl[:, None]
+            s = jnp.matmul(rf, centroids.T,
+                           precision=lax.Precision.HIGHEST,
+                           preferred_element_type=jnp.float32)  # [c, nlist]
+            a = jnp.argmax(s, axis=1).astype(jnp.int32)
+            ridx = ci * c + jnp.arange(c, dtype=jnp.int32)
+            w = (ridx < valid_local).astype(jnp.float32)
+            oh = jax.nn.one_hot(a, nlist, dtype=jnp.float32) * w[:, None]
+            sums = sums + jnp.matmul(oh.T, rf,
+                                     precision=lax.Precision.HIGHEST)
+            counts = counts + oh.sum(axis=0)
+            return (sums, counts), jnp.where(ridx < valid_local, a, -1)
+
+        (sums, counts), assign = lax.scan(
+            body, init,
+            (jnp.arange(n_chunks, dtype=jnp.int32), blocks, sblocks))
+        sums = lax.psum(sums, "data")
+        counts = lax.psum(counts, "data")
+        return sums, counts, assign.reshape(-1)[:rows]
+
+    if scaled:
+        fn = run
+        in_specs = (P("data"), P("data"), P(), P())
+    else:
+        fn = lambda rows, valid, cents: run(rows, None, valid, cents)  # noqa: E731
+        in_specs = (P("data"), P(), P())
+    # psum makes sums/counts replicated — a dynamic fact the static
+    # varying-axis checker can't infer (same escape hatch as sharded_topk)
+    mapped = shard_map_unchecked(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=(P(), P(), P("data")))
+    return jax.jit(mapped)
+
+
+def shard_pass(pages, scales, valid: int, centroids, mesh: Mesh,
+               nlist: int, chunk: int = 8192):
+    """One staged shard through the assignment/accumulation pass. `pages`
+    and `scales` come from ops.topk.stage_shard (stored width, row-sharded);
+    `centroids` is a replicated [nlist, D] f32 array."""
+    key = (mesh, int(nlist), int(chunk), scales is not None)
+    fn = _PASS_CACHE.get(key)
+    if fn is None:
+        fn = _PASS_CACHE[key] = _build_shard_pass(
+            mesh, nlist, chunk, scales is not None)
+    v = jnp.int32(valid)
+    return (fn(pages, v, centroids) if scales is None
+            else fn(pages, scales, v, centroids))
+
+
+def _normalize(c: np.ndarray) -> np.ndarray:
+    n = np.linalg.norm(c, axis=1, keepdims=True)
+    return (c / np.maximum(n, 1e-12)).astype(np.float32)
+
+
+def sample_rows(store, n: int, seed: int) -> np.ndarray:
+    """Seeded deterministic sample of up to `n` dequantized f32 rows,
+    proportional per shard, in (shard, row) order — the k-means init set
+    and the empty-cluster reseed pool."""
+    N = store.num_vectors
+    out = []
+    for entry in store.shards():
+        cnt = entry["count"]
+        if cnt == 0:
+            continue
+        quota = min(cnt, max(1, -(-n * cnt // max(N, 1))))
+        rng = np.random.default_rng([seed, entry["index"]])
+        rows = np.sort(rng.choice(cnt, size=quota, replace=False))
+        _, vecs = store._load_entry(entry)           # dequantized rows
+        out.append(np.asarray(vecs[rows], np.float32))
+    if not out:
+        return np.zeros((0, store.dim), np.float32)
+    return np.concatenate(out)[:n]
+
+
+def _padded_rows(store, mesh: Mesh) -> int:
+    """One static row count for every staged shard -> one compiled pass."""
+    rows = max((s["count"] for s in store.shards()), default=0)
+    return rows + (-rows) % max(mesh.shape["data"], 1)
+
+
+def _iter_staged(store, mesh: Mesh, rows: int, sample_per_shard=None,
+                 rng_key=None):
+    """Yield (entry, valid_n, pages, scales) for every non-empty shard,
+    staged at stored width. With `sample_per_shard`, a seeded per-shard row
+    subset (the mini-batch) is staged instead of the full shard."""
+    entries = store.shards()
+    for entry, (ids, vecs, scl) in zip(
+            entries, store.iter_shards(raw=True, prefetch=1)):
+        n = vecs.shape[0]
+        if n == 0:
+            continue
+        if sample_per_shard is not None and n > sample_per_shard:
+            rng = np.random.default_rng([*rng_key, entry["index"]])
+            take = np.sort(rng.choice(n, size=sample_per_shard,
+                                      replace=False))
+            vecs = np.asarray(vecs)[take]
+            scl = None if scl is None else np.asarray(scl)[take]
+            n = sample_per_shard
+        pages, scales = stage_shard(vecs, rows, store.dim, mesh, scales=scl)
+        yield entry, n, pages, scales
+
+
+def train_kmeans(store, mesh: Mesh, nlist: int, iters: int = 8,
+                 seed: int = 0, chunk: int = 8192,
+                 sample_per_shard: Optional[int] = None,
+                 init_sample: int = 65_536) -> Tuple[np.ndarray, Dict]:
+    """Train `nlist` unit-norm centroids over the store. Returns
+    (centroids [nlist, D] f32, stats). Deterministic for a given
+    (store bytes, seed, mesh, backend)."""
+    N = store.num_vectors
+    if N == 0:
+        raise ValueError("cannot train k-means over an empty store")
+    nlist = int(min(max(1, nlist), N))
+    pool = sample_rows(store, max(nlist, min(init_sample, N)), seed)
+    rng = np.random.default_rng(seed)
+    centroids = _normalize(
+        pool[rng.choice(pool.shape[0], size=nlist, replace=False)])
+    rows = _padded_rows(store, mesh)
+    reseeded = 0
+    for it in range(max(1, iters)):
+        sums = np.zeros((nlist, store.dim), np.float64)
+        counts = np.zeros((nlist,), np.float64)
+        cdev = jnp.asarray(centroids)
+        for _, n, pages, scales in _iter_staged(
+                store, mesh, rows, sample_per_shard=sample_per_shard,
+                rng_key=(seed, 1 + it)):
+            s, c, _ = shard_pass(pages, scales, n, cdev, mesh, nlist,
+                                 chunk=chunk)
+            sums += np.asarray(s, np.float64)
+            counts += np.asarray(c, np.float64)
+        new = centroids.astype(np.float64).copy()
+        nz = counts > 0
+        new[nz] = sums[nz] / counts[nz, None]
+        empty = np.nonzero(~nz)[0]
+        if empty.size:                 # reseed dead clusters from the pool
+            r2 = np.random.default_rng([seed, 2, it])
+            new[empty] = pool[r2.integers(0, pool.shape[0], empty.size)]
+            reseeded += int(empty.size)
+        centroids = _normalize(new.astype(np.float32))
+    return centroids, {"nlist": nlist, "iters": int(max(1, iters)),
+                       "reseeded": reseeded,
+                       "trained_rows": int(N if sample_per_shard is None
+                                           else min(N, sample_per_shard
+                                                    * len(store.shards())))}
+
+
+def assign_store(store, mesh: Mesh, centroids: np.ndarray,
+                 chunk: int = 8192) -> Iterator[Tuple[Dict, np.ndarray]]:
+    """Final assignment sweep: yield (shard entry, assign [count] i32) for
+    every non-empty shard, streaming one shard at a time through the same
+    compiled pass the trainer used (sums/counts are discarded)."""
+    nlist = centroids.shape[0]
+    rows = _padded_rows(store, mesh)
+    cdev = jnp.asarray(centroids, jnp.float32)
+    for entry, n, pages, scales in _iter_staged(store, mesh, rows):
+        _, _, assign = shard_pass(pages, scales, n, cdev, mesh, nlist,
+                                  chunk=chunk)
+        yield entry, np.asarray(assign, np.int32)[:n]

@@ -27,6 +27,16 @@ most of every dispatch on padding. Three mechanisms recover that width:
     (ensure_model_step / model reload) changes the key and invalidates
     every entry. Hit/miss counters surface through metrics().
 
+ANN routing (docs/ANN.md): with `serve.index = "ivf"` queries route
+through the inverted-file index (index/ivf.py) — centroid scan +
+top-`serve.nprobe` posting-list gather + exact on-device re-rank, cost
+~nprobe/nlist of the exact sweep — with automatic PER-REQUEST fallback to
+the exact path when the index is missing, stale against the store's model
+step, or quarantined. `ann_lists_scanned` / `ann_candidates_reranked` /
+`ann_fallbacks` and the active index config surface through metrics().
+The default `serve.index = "exact"` keeps the pre-index paths below
+byte-identical.
+
 HBM pre-staging: when the store fits the configured budget, every shard is
 device_put once (row-sharded over the mesh 'data' axis, padded to one
 static shape so a single compiled top-k program serves all shards) and
@@ -173,6 +183,22 @@ class SearchService:
         self._cache_lock = threading.Lock()
         self.cache_hits = 0
         self.cache_misses = 0
+        # IVF ANN routing (docs/ANN.md): serve.index="ivf" tries the
+        # inverted-file index; every request re-checks it against the
+        # store's stamp and falls back to the exact path (counted) when
+        # the index is missing/stale/quarantined. "exact" (the default)
+        # never touches the index machinery — byte-identical behavior.
+        self._serve_index = (getattr(serve_cfg, "index", "exact")
+                             if serve_cfg is not None else "exact")
+        self._nprobe = (getattr(serve_cfg, "nprobe", 8)
+                        if serve_cfg is not None else 8)
+        self._index = None
+        self._index_error: Optional[str] = None
+        self.ann_lists_scanned = 0
+        self.ann_candidates_reranked = 0
+        self.ann_fallbacks = 0
+        if self._serve_index == "ivf":
+            self._open_index()
         self._batcher: Optional[_MicroBatcher] = None
         self._batch_sizes: List[int] = []   # telemetry after close()
         self._log = log
@@ -218,6 +244,8 @@ class SearchService:
                 "serve_vectors": store.num_vectors,
                 "serve_query_batch": self.query_batch,
                 "serve_query_cache_size": self._cache_cap,
+                "serve_index": self._serve_index,
+                "serve_ann_available": self._index is not None,
                 "fault_counters": faults.counters(),
             })
 
@@ -228,6 +256,43 @@ class SearchService:
     def _count_fault(self, name: str) -> None:
         self.fault_counters[name] = self.fault_counters.get(name, 0) + 1
         faults.count(name)
+
+    # -- IVF ANN index (docs/ANN.md) ---------------------------------------
+    def _open_index(self) -> None:
+        from dnn_page_vectors_tpu.index.ivf import IndexUnavailable, IVFIndex
+        try:
+            self._index = IVFIndex.open(self.store)
+            self._index_error = None
+        except IndexUnavailable as e:
+            self._index = None
+            self._index_error = str(e)
+            faults.warn(f"IVF index unavailable ({e}); serving the exact "
+                        "path per request")
+
+    def _search_ann(self, qv: np.ndarray, n: int, k: int
+                    ) -> Optional[List[List[Dict]]]:
+        """ANN answer for `n` real queries, or None to fall back to the
+        exact path (index missing, stale against the store's CURRENT model
+        step, or failing at search time — the failure quarantine already
+        happened inside the index layer)."""
+        idx = self._index
+        if idx is None or idx.model_step != self.store.model_step:
+            return None
+        prof = self.profiler
+        try:
+            with prof.stage("topk"):
+                scores, ids, st = idx.search(qv[:n], k=k,
+                                             nprobe=self._nprobe)
+        except Exception as e:  # noqa: BLE001 — any index failure degrades
+            self._index = None
+            self._index_error = f"{type(e).__name__}: {e}"
+            faults.warn(f"IVF search failed ({self._index_error}); "
+                        "falling back to exact search")
+            return None
+        self.ann_lists_scanned += st.get("lists_scanned", 0)
+        self.ann_candidates_reranked += st.get("candidates_reranked", 0)
+        with prof.stage("format"):
+            return [self._format(scores[i], ids[i]) for i in range(n)]
 
     def _preload(self, rows: int, budget_bytes: float, per_row: int) -> None:
         import jax
@@ -432,6 +497,19 @@ class SearchService:
         if sizes:
             rec["serve_batches"] = len(sizes)
             rec["serve_mean_batch"] = round(sum(sizes) / len(sizes), 2)
+        if self._serve_index != "exact":
+            # ANN counters + the active index config (the PR 3
+            # cache-counter pattern: flat keys, always present when the
+            # feature is on, so dashboards need no key-existence logic)
+            rec["ann_lists_scanned"] = self.ann_lists_scanned
+            rec["ann_candidates_reranked"] = self.ann_candidates_reranked
+            rec["ann_fallbacks"] = self.ann_fallbacks
+            rec["ann_index"] = {
+                "index": self._serve_index, "nprobe": self._nprobe,
+                "nlist": self._index.nlist if self._index else None,
+                "available": self._index is not None,
+                **({"error": self._index_error}
+                   if self._index_error else {})}
         if self.fault_counters:
             rec["fault_counters"] = faults.counters()
         return rec
@@ -482,6 +560,13 @@ class SearchService:
             return []
         qv = self._embed_queries_cached(list(queries))
         prof = self.profiler
+        if self._serve_index == "ivf":
+            res = self._search_ann(qv, n, k)
+            if res is not None:
+                return res
+            # exact path serves this request; visible in metrics + counters
+            self.ann_fallbacks += n
+            faults.count("serve_ann_fallbacks", n)
         B = self.query_batch
         if self._shards is None:
             # streaming store: pad the query matrix to a bucket multiple so

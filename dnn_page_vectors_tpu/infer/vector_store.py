@@ -104,14 +104,23 @@ def read_ahead(it, depth: int = 1):
             raise err[0]
 
 
-def _crc_file(path: str) -> int:
+def crc_file(path: str) -> int:
     """Streaming CRC32 of a file's bytes (header included — a torn npy
-    header is corruption too)."""
+    header is corruption too). Shared with the IVF ANN index
+    (index/ivf.py), which persists its centroids + posting lists in an
+    `ivf/` subdirectory of the store under the same bytes+CRC32+
+    model-step-stamp manifest machinery: an `ensure_model_step` re-stamp
+    or any shard-table change invalidates the index structurally (its
+    recorded stamp/shard table no longer matches), and corrupt index
+    files are quarantined the same way shards are."""
     crc = 0
     with open(path, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             crc = zlib.crc32(chunk, crc)
     return crc & 0xFFFFFFFF
+
+
+_crc_file = crc_file        # internal alias (pre-index spelling)
 
 
 def prepare_store(directory: str, dim: int, shard_size: Optional[int],

@@ -1,14 +1,16 @@
 """ANN hard-negative miner (SURVEY.md §3 #21; BASELINE.json:10; call stack §4.4).
 
 The reference mined hard negatives with an ANN index over the embedded
-corpus. The TPU-native path is exact brute-force retrieval on the MXU: embed
-queries with the current params, stream the vector store — one disk shard at
-a time, row-sharded over the mesh 'data' axis — through the cross-shard
-top-k merge (ops/topk.py:topk_over_store), drop the gold page, keep the top
-H as negatives. One pass over the store total, O(one shard) memory, so
-mining scales to the 100M-page corpus (BASELINE.md; VERDICT r1 #2). Mined
-lists feed back into training via TrainBatcher.hard_negative_lookup (the
-mine -> train loop of config 4).
+corpus. Two TPU-native retrieval paths serve that role here: exact
+brute-force on the MXU — embed queries with the current params, stream the
+vector store (one disk shard at a time, row-sharded over the mesh 'data'
+axis) through the cross-shard top-k merge (ops/topk.py:topk_over_store) —
+or, with `index=` (an IVF index, index/ivf.py, docs/ANN.md), a sublinear
+top-`nprobe` posting scan with exact re-rank, so mining stops paying a
+full store sweep per query block. Either way: drop the gold page, keep the
+top H as negatives, O(one shard) memory, so mining scales to the 100M-page
+corpus (BASELINE.md; VERDICT r1 #2). Mined lists feed back into training
+via TrainBatcher.hard_negative_lookup (the mine -> train loop of config 4).
 """
 from __future__ import annotations
 
@@ -96,7 +98,9 @@ def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
                         search_k: int = 100,
                         num_queries: Optional[int] = None,
                         query_block: Optional[int] = None,
-                        out_path: Optional[str] = None) -> HardNegatives:
+                        out_path: Optional[str] = None,
+                        index=None,
+                        nprobe: Optional[int] = None) -> HardNegatives:
     """Top-`search_k` retrieval per training query minus the gold page,
     truncated to `num_negatives`. Queries are embedded with CURRENT params
     (periodic re-mining keeps negatives hard as the model improves).
@@ -120,6 +124,13 @@ def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
     merged file — peak host memory is O(query_block * max(H, search_k))
     at ANY process count. This requires a shared filesystem and `out_path`,
     the same contract the store's multi-writer embed already has.
+
+    With `index` (an index.ivf.IVFIndex over this store), each query block
+    scans only its top-`nprobe` posting lists plus an exact re-rank
+    (docs/ANN.md) instead of sweeping the full store — the sublinear path
+    for config-4 scale mining. Retrieval is approximate; mined negatives
+    are "hard" by construction either way, and any lists the ANN misses
+    are by definition the least-similar candidates.
     """
     from dnn_page_vectors_tpu.parallel.multihost import barrier, process_info
     nq = min(num_queries or corpus.num_pages, corpus.num_pages)
@@ -149,9 +160,13 @@ def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
         e = min(s + qb, hi)
         qvecs = embedder.embed_texts(
             [corpus.query_text(i) for i in range(s, e)], tower="query")
-        _, retrieved = topk_over_store(
-            np.asarray(qvecs, np.float32), store, embedder.mesh, k=k,
-            query_batch=embedder.cfg.eval.embed_batch_size)
+        if index is not None:
+            _, retrieved, _ = index.search(
+                np.asarray(qvecs, np.float32), k=k, nprobe=nprobe)
+        else:
+            _, retrieved = topk_over_store(
+                np.asarray(qvecs, np.float32), store, embedder.mesh, k=k,
+                query_batch=embedder.cfg.eval.embed_batch_size)
         table[s - lo: e - lo] = _pick_negatives(
             retrieved, np.arange(s, e, dtype=np.int64), H, corpus.num_pages)
     if out_path is not None:

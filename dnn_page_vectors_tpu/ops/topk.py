@@ -14,6 +14,10 @@ the MXU. Exact (brute-force) search, three tiers:
     `sharded_topk`, merging on host. Peak footprint is ONE store shard
     spread over the mesh, so 1B-page retrieval (BASELINE.md:16) runs on a
     fixed memory budget. Used by evals/recall.py and mine/ann.py.
+
+`rerank_candidates` is the exact half of the IVF ANN path (index/ivf.py,
+docs/ANN.md): the same fused-widening matmul over a GATHERED candidate
+block instead of the whole corpus, masked per query to its probed lists.
 """
 from __future__ import annotations
 
@@ -179,6 +183,34 @@ def sharded_topk(q: jnp.ndarray, pages, mesh: Mesh, k: int = 10,
                          f"{mesh.shape['data']}; pad the input")
     v = jnp.int32(N if valid is None else valid)
     return fn(q, pages, v) if scales is None else fn(q, pages, scales, v)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def rerank_candidates(q: jnp.ndarray, cand, scales, cand_cent: jnp.ndarray,
+                      selected: jnp.ndarray, k: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact re-rank of gathered IVF candidates (index/ivf.py): one MXU
+    matmul of q [B, D] against the candidate block cand [C, D] (fp16 rows
+    or int8 codes with per-row `scales` — widening fused into the matmul,
+    same contract as _topk_scan), masked so each query only keeps
+    candidates whose centroid id (cand_cent [C], -1 = padding) is in ITS
+    probed set (selected [B, nprobe]), then lax.top_k. Returns
+    (scores [B, k], positions into cand [B, k], -1 where fewer than k
+    candidates matched). nprobe is a static shape, so the mask is an
+    unrolled OR over nprobe [B, C] comparisons — never an [B, nprobe, C]
+    materialization."""
+    s = jnp.matmul(q, cand.T.astype(jnp.float32),
+                   precision=lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32)        # [B, C]
+    if scales is not None:
+        s = s * scales.astype(jnp.float32)[None, :]
+    hit = cand_cent[None, :] == selected[:, 0:1]
+    for p in range(1, selected.shape[1]):
+        hit = hit | (cand_cent[None, :] == selected[:, p:p + 1])
+    s = jnp.where(hit, s, -jnp.inf)      # padding (cent -1) never matches
+    top_s, pos = lax.top_k(s, min(k, s.shape[1]))
+    pos = jnp.where(jnp.isfinite(top_s), pos, -1)
+    return top_s, pos
 
 
 def merge_topk_host(best_s: np.ndarray, best_i: np.ndarray,

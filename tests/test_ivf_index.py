@@ -1,0 +1,278 @@
+"""IVF ANN index (index/kmeans.py, index/ivf.py, docs/ANN.md): seeded
+build determinism, the recall-vs-exact contract on the toy corpus,
+model-step re-stamp invalidation, and quarantined-posting fallback to the
+exact serving path under a seeded FaultPlan."""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.evals.recall import recall_vs_exact
+from dnn_page_vectors_tpu.index.ivf import (
+    IndexUnavailable, IVFIndex, index_dir)
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.serve import SearchService
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.ops.topk import topk_over_store
+from dnn_page_vectors_tpu.train.loop import Trainer
+from dnn_page_vectors_tpu.utils import faults
+
+pytestmark = pytest.mark.ann
+
+_OV = {
+    "data.num_pages": 300,
+    "data.trigram_buckets": 2048,
+    "model.embed_dim": 48,
+    "model.conv_channels": 96,
+    "model.out_dim": 48,
+    "train.batch_size": 64,
+    "train.steps": 60,
+    "train.warmup_steps": 10,
+    "train.learning_rate": 2e-3,
+    "train.log_every": 1000,
+    "eval.embed_batch_size": 100,
+    "eval.store_shard_size": 100,   # 3 shards: per-shard posting lists
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    """One trained model + embedded 3-shard store for the whole module;
+    destructive tests copy the store directory instead of mutating it."""
+    wd = tmp_path_factory.mktemp("ivf_env")
+    cfg = get_config("cdssm_toy", _OV)
+    trainer = Trainer(cfg, workdir=str(wd))
+    state, _ = trainer.train()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+    store = VectorStore(os.path.join(str(wd), "store"),
+                        dim=cfg.model.out_dim, shard_size=100)
+    store.ensure_model_step(int(state.step))
+    emb.embed_corpus(trainer.corpus, store)
+    # checkpoint so CLI subcommands restore THESE params (store stamp and
+    # restored step must agree for the index to be valid under `search`)
+    from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(os.path.join(str(wd), "ckpt"))
+    mgr.save(int(state.step), state, wait=True)
+    mgr.close()
+    return {"cfg": cfg, "trainer": trainer, "emb": emb, "store": store,
+            "wd": str(wd)}
+
+
+def _copy_store(env, tmp_path):
+    """Private byte-identical copy of the embedded store (no index)."""
+    dst = os.path.join(str(tmp_path), "store")
+    shutil.copytree(env["store"].directory, dst)
+    shutil.rmtree(os.path.join(dst, "ivf"), ignore_errors=True)
+    return VectorStore(dst)
+
+
+def _ivf_cfg(env, nprobe=None):
+    import dataclasses
+    serve = dataclasses.replace(env["cfg"].serve, index="ivf",
+                                **({} if nprobe is None
+                                   else {"nprobe": nprobe}))
+    return env["cfg"].replace(serve=serve)
+
+
+def test_build_is_seed_deterministic(env, tmp_path):
+    """Same store bytes + seed -> byte-identical centroids and postings
+    (the manifest differs only in build_seconds)."""
+    a = _copy_store(env, tmp_path / "a")
+    b = _copy_store(env, tmp_path / "b")
+    mesh = env["emb"].mesh
+    ia = IVFIndex.build(a, mesh, nlist=16, iters=5, seed=3)
+    ib = IVFIndex.build(b, mesh, nlist=16, iters=5, seed=3)
+    names = sorted(n for n in os.listdir(index_dir(a))
+                   if n.endswith(".npy"))
+    assert names and names == sorted(
+        n for n in os.listdir(index_dir(b)) if n.endswith(".npy"))
+    for n in names:
+        with open(os.path.join(index_dir(a), n), "rb") as f:
+            bytes_a = f.read()
+        with open(os.path.join(index_dir(b), n), "rb") as f:
+            bytes_b = f.read()
+        assert bytes_a == bytes_b, f"{n} differs between seeded builds"
+    # manifests agree on everything but wall-clock
+    ma, mb = dict(ia.manifest), dict(ib.manifest)
+    ma.pop("build_seconds"), mb.pop("build_seconds")
+    assert ma == mb
+    # a different seed is allowed to (and here does) move centroids
+    c = _copy_store(env, tmp_path / "c")
+    ic = IVFIndex.build(c, mesh, nlist=16, iters=5, seed=4)
+    assert not np.array_equal(ic.centroids, ia.centroids)
+
+
+def test_recall_vs_exact_and_serving_contract(env):
+    """On the toy corpus at the DEFAULT nprobe: index recall@10 >= 0.95 of
+    the exact top-10, search_many through serve.index=ivf matches that
+    contract, the exact path stays the default, and ANN counters move."""
+    cfg = env["cfg"]
+    assert cfg.serve.index == "exact"        # pre-PR behavior is default
+    store, emb, trainer = env["store"], env["emb"], env["trainer"]
+    IVFIndex.build(store, emb.mesh, seed=0)  # auto nlist (~sqrt N)
+    idx = IVFIndex.open(store)
+    queries = [trainer.corpus.query_text(i) for i in range(0, 300, 7)]
+    qv = np.asarray(emb.embed_texts(queries, tower="query"), np.float32)
+    r = recall_vs_exact(idx, store, qv, emb.mesh, k=10,
+                        nprobe=cfg.serve.nprobe)
+    assert r >= 0.95, f"ANN recall@10 vs exact {r:.3f} < 0.95"
+
+    exact_svc = SearchService(cfg, emb, trainer.corpus, store,
+                              preload_hbm_gb=4.0)
+    ann_svc = SearchService(_ivf_cfg(env), emb, trainer.corpus, store,
+                            preload_hbm_gb=0.0)
+    assert ann_svc._index is not None
+    got = ann_svc.search_many(queries, k=10)
+    want = exact_svc.search_many(queries, k=10)
+    overlap = np.mean([
+        len({r["page_id"] for r in g} & {r["page_id"] for r in w})
+        / max(len(w), 1)
+        for g, w in zip(got, want)])
+    assert overlap >= 0.95, f"serving overlap {overlap:.3f} < 0.95"
+    assert ann_svc.ann_fallbacks == 0
+    met = ann_svc.metrics()
+    assert met["ann_lists_scanned"] >= len(queries) * cfg.serve.nprobe
+    assert met["ann_candidates_reranked"] > 0
+    assert met["ann_index"]["available"] and \
+        met["ann_index"]["nlist"] == idx.nlist
+    # the exact service reports no ann keys at all (counter pattern only
+    # activates with the feature)
+    assert "ann_lists_scanned" not in exact_svc.metrics()
+
+
+def test_full_probe_equals_exact(env):
+    """nprobe == nlist scans every list: result ids must EQUAL the exact
+    sweep (the ANN path is exact search plus routing at full probe)."""
+    store, emb = env["store"], env["emb"]
+    IVFIndex.build(store, emb.mesh, nlist=8, iters=4, seed=0)
+    idx = IVFIndex.open(store)
+    qv = np.asarray(emb.embed_texts(
+        [env["trainer"].corpus.query_text(i) for i in (0, 11, 123)],
+        tower="query"), np.float32)
+    _, ann_ids, _ = idx.search(qv, k=10, nprobe=8)
+    _, exact_ids = topk_over_store(qv, store, emb.mesh, k=10)
+    for a, e in zip(ann_ids, exact_ids):
+        assert set(a.tolist()) == set(e.tolist())
+
+
+def test_int8_store_full_probe_equals_exact(tmp_path):
+    """INT8 stores end to end: k-means assignment, posting gather, and the
+    re-rank all run on stored-width codes with the per-row scales fused on
+    device — at full probe the ANN ids must equal the exact sweep's over
+    the same quantized store."""
+    from dnn_page_vectors_tpu.config import MeshConfig
+    from dnn_page_vectors_tpu.parallel.mesh import make_mesh
+    rng = np.random.default_rng(3)
+    N, D = 500, 32
+    vecs = rng.normal(size=(N, D)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    store = VectorStore(str(tmp_path / "s"), dim=D, shard_size=200,
+                        dtype="int8")
+    store.ensure_model_step(1)
+    for i in range(0, N, 200):
+        store.write_shard(i // 200, np.arange(i, min(i + 200, N)),
+                          vecs[i: i + 200])
+    mesh = make_mesh(MeshConfig(data=4))
+    idx = IVFIndex.build(store, mesh, nlist=10, iters=4, seed=0)
+    q = vecs[rng.choice(N, 20, replace=False)]
+    _, ann_ids, _ = idx.search(q, k=5, nprobe=10)
+    _, exact_ids = topk_over_store(q, store, mesh, k=5)
+    for a, e in zip(ann_ids, exact_ids):
+        assert set(a.tolist()) == set(e.tolist())
+
+
+def test_model_step_restamp_invalidates(env, tmp_path):
+    """An ensure_model_step re-stamp (stale vectors dropped, new stamp)
+    must structurally invalidate the index: open() raises, and a running
+    ivf service falls back to exact per request."""
+    store = _copy_store(env, tmp_path)
+    emb, trainer = env["emb"], env["trainer"]
+    IVFIndex.build(store, emb.mesh, nlist=8, iters=3, seed=0)
+    IVFIndex.open(store)                                   # valid now
+    svc = SearchService(_ivf_cfg(env), emb, trainer.corpus, store,
+                        preload_hbm_gb=0.0)
+    assert svc._index is not None
+    step = store.model_step
+    store.ensure_model_step(step + 1)                      # reset + restamp
+    with pytest.raises(IndexUnavailable, match="stale"):
+        IVFIndex.open(store)
+    # the already-open service re-checks the stamp per request: exact
+    # fallback (empty store now -> no results), counted
+    assert svc.search("anything", k=5) == []
+    assert svc.ann_fallbacks == 1
+    assert svc.metrics()["ann_fallbacks"] == 1
+
+
+def test_quarantined_posting_falls_back_to_exact(env, tmp_path):
+    """A seeded FaultPlan corrupts one posting file post-fsync (media rot
+    the writer can't see). open() must quarantine it and report the index
+    unavailable; a serve.index=ivf service then answers every query
+    through the exact path — same results as an exact service — and
+    counts the fallbacks."""
+    store = _copy_store(env, tmp_path)
+    emb, trainer = env["emb"], env["trainer"]
+    faults.install(faults.FaultPlan.parse("index_file:bit_flip:1", seed=7))
+    IVFIndex.build(store, emb.mesh, nlist=8, iters=3, seed=0)
+    with pytest.raises(IndexUnavailable):
+        IVFIndex.open(store)
+    assert faults.counters().get("quarantined_index_files") == 1
+    svc = SearchService(_ivf_cfg(env), emb, trainer.corpus, store,
+                        preload_hbm_gb=4.0)
+    assert svc._index is None and "rebuild" in (svc._index_error or "")
+    exact = SearchService(env["cfg"], emb, trainer.corpus, store,
+                          preload_hbm_gb=4.0)
+    queries = [trainer.corpus.query_text(i) for i in (2, 77, 290)]
+    got = svc.search_many(queries, k=10)
+    want = exact.search_many(queries, k=10)
+    assert [[r["page_id"] for r in g] for g in got] == \
+        [[r["page_id"] for r in w] for w in want]
+    assert svc.ann_fallbacks == len(queries)
+    assert svc.metrics()["ann_fallbacks"] == len(queries)
+    assert not svc.metrics()["ann_index"]["available"]
+
+
+def test_cli_index_and_nprobe_search(env, capsys):
+    """The `index` subcommand builds from the on-disk store + config and
+    reports nlist/build seconds/imbalance; `search --nprobe N` routes the
+    query through the index."""
+    from dnn_page_vectors_tpu import cli
+    base = ["--config", "cdssm_toy", "--workdir", env["wd"]] + [
+        x for key, val in _OV.items() for x in ("--set", f"{key}={val}")]
+    cli.main(["index"] + base + ["--set", "serve.nlist=16"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["nlist"] == 16 and out["vectors"] == 300
+    assert out["build_seconds"] > 0 and out["imbalance"] >= 1.0
+    gold = 3
+    query = env["trainer"].corpus.query_text(gold)
+    cli.main(["search", "--query", query, "--nprobe", "8"] + base)
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(res["results"]) == 10
+    assert gold in [r["page_id"] for r in res["results"]]
+
+
+@pytest.mark.slow
+def test_large_nlist_build(env, tmp_path):
+    """Large-nlist build on the toy store: every centroid survives (or is
+    reseeded), every row lands in exactly one posting list, and recall at
+    full probe stays exact."""
+    store = _copy_store(env, tmp_path)
+    emb = env["emb"]
+    idx = IVFIndex.build(store, emb.mesh, nlist=128, iters=8, seed=0)
+    assert idx.nlist == 128
+    assert int(idx.list_sizes.sum()) == store.num_vectors
+    qv = np.asarray(emb.embed_texts(
+        [env["trainer"].corpus.query_text(i) for i in range(40)],
+        tower="query"), np.float32)
+    r = recall_vs_exact(idx, store, qv, emb.mesh, k=10, nprobe=128)
+    assert r == 1.0
