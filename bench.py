@@ -410,6 +410,69 @@ def run_worker() -> None:
                             (n_q / adt) / max(rec.get("serve_qps") or 1e-9,
                                               1e-9), 3),
                     })
+
+                    # ---- update sub-phase: live append + hot-swap ----
+                    # The live-update treatment (docs/UPDATES.md): append
+                    # one shard of new pages to the serve store as a
+                    # generation, refresh() a live ANN service (incremental
+                    # index update + atomic view swap), and measure the
+                    # operator-facing numbers — append throughput, index
+                    # update cost (O(new shards)), the swap's downtime
+                    # window, and post-append ANN recall on the NEW pages.
+                    # Skippable via BENCH_UPDATE=0.
+                    if os.environ.get("BENCH_UPDATE", "1") != "0":
+                        try:
+                            from dnn_page_vectors_tpu.updates import (
+                                append_corpus)
+                            n_app = int(os.environ.get(
+                                "BENCH_UPDATE_PAGES", str(shard_rows)))
+                            base_n = sstore.num_vectors
+                            _stamp(f"update phase: appending {n_app} pages "
+                                   f"to the {base_n}-page serve store")
+                            usvc = SearchService(acfg, embedder,
+                                                 trainer.corpus, sstore,
+                                                 preload_hbm_gb=4.0)
+                            usvc.warmup(k=kq)
+                            astats = append_corpus(
+                                embedder, trainer.corpus, sstore,
+                                stop=base_n + n_app, tombstone=[0])
+                            t0 = time.perf_counter()
+                            rinfo = usvc.refresh()
+                            uq = [trainer.corpus.query_text(base_n + i)
+                                  for i in range(min(distinct, n_app))]
+                            uqv = _np3.asarray(
+                                embedder.embed_texts(uq, tower="query"),
+                                _np3.float32)
+                            r10u = (recall_vs_exact(
+                                usvc._index, sstore, uqv, embedder.mesh,
+                                k=10, nprobe=cfg.serve.nprobe)
+                                if usvc._index is not None else None)
+                            usvc.close()
+                            iupd = rinfo.get("index_update") or {}
+                            rec.update({
+                                "append_pages": n_app,
+                                "append_docs_per_s":
+                                    astats["append_docs_per_s"],
+                                "index_update_seconds": iupd.get("seconds"),
+                                "index_update_action": iupd.get("action"),
+                                "refresh_seconds":
+                                    rinfo["refresh_seconds"],
+                                "refresh_swap_ms": rinfo["swap_ms"],
+                                "post_append_recall_at_10":
+                                    (round(r10u, 4) if r10u is not None
+                                     else None),
+                                "store_generation":
+                                    rinfo["store_generation"],
+                            })
+                            _stamp(
+                                f"update phase done: append "
+                                f"{astats['append_docs_per_s']:.0f} docs/s, "
+                                f"index {iupd.get('action')} in "
+                                f"{iupd.get('seconds')}s, swap "
+                                f"{rinfo['swap_ms']:.1f} ms")
+                        except Exception as e:  # keep serve + ann data
+                            rec["update_error"] = \
+                                f"{type(e).__name__}: {e}"[:300]
                 except Exception as e:  # ann failure must keep serve data
                     rec["ann_error"] = f"{type(e).__name__}: {e}"[:300]
         except Exception as e:  # optional phase must never cost the round

@@ -9,6 +9,9 @@
   python -m dnn_page_vectors_tpu.cli index --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --nprobe 8 ...
   python -m dnn_page_vectors_tpu.cli pipeline --config hardneg_v5p64 --rounds 4
+  python -m dnn_page_vectors_tpu.cli append --config cdssm_toy \
+      --set data.num_pages=12000 --tombstone 17,42
+  python -m dnn_page_vectors_tpu.cli refresh --config cdssm_toy
 
 Any config field is overridable with --set section.field=value; every flag
 round-trips through the Config dataclasses (SURVEY.md §5.6).
@@ -102,7 +105,15 @@ def main(argv=None) -> None:
     ap.add_argument("command", choices=["train", "embed", "eval", "mine",
                                         "search", "pipeline", "configs",
                                         "init-store", "merge-store",
-                                        "reset-store", "index"])
+                                        "reset-store", "index", "append",
+                                        "refresh"])
+    ap.add_argument("--tombstone", default=None, metavar="IDS",
+                    help="append: comma-separated page ids to DELETE (their "
+                         "vectors mask out of every retrieval path)")
+    ap.add_argument("--update-ids", default=None, metavar="IDS",
+                    help="append: comma-separated existing page ids to "
+                         "RE-EMBED into the new generation (old rows "
+                         "tombstoned automatically)")
     ap.add_argument("--query", default=None,
                     help="search: free-text query to embed and retrieve for")
     ap.add_argument("--queries", default=None, metavar="FILE",
@@ -212,13 +223,43 @@ def main(argv=None) -> None:
         idx = IVFIndex.build(store, local_mesh(cfg.mesh),
                              nlist=cfg.serve.nlist,
                              iters=cfg.serve.kmeans_iters,
-                             seed=cfg.data.seed)
+                             seed=cfg.data.seed,
+                             init=cfg.serve.kmeans_init)
+        # init->final imbalance delta: what the seeding bought (k-means++
+        # vs the random draw it replaced; docs/ANN.md)
+        init_imb = float(idx.manifest.get("init_imbalance", 0.0))
         print(json.dumps({
             "store": store_dir, "vectors": store.num_vectors,
             "nlist": idx.nlist, "imbalance": idx.imbalance,
+            "kmeans_init": idx.manifest.get("init"),
+            "imbalance_init": init_imb,
+            "imbalance_delta": round(init_imb - idx.imbalance, 4),
             "model_step": idx.model_step,
             "build_seconds": round(_time.perf_counter() - t0, 3),
             "fault_counters": faults.counters()}, sort_keys=True))
+        return
+
+    if args.command == "refresh":
+        # Bring the IVF index up to date with an appended store
+        # (docs/UPDATES.md): incremental posting append in O(new shards),
+        # or a drift-triggered full rebuild. Needs no model — just the
+        # store and a device mesh for the assignment pass. A serving
+        # process picks the result up on its next SearchService.refresh()
+        # (or `:refresh` in `search --interactive`).
+        from dnn_page_vectors_tpu.index.ivf import IVFIndex
+        from dnn_page_vectors_tpu.parallel.multihost import local_mesh
+        store = VectorStore(store_dir)
+        idx, info = IVFIndex.update(store, local_mesh(cfg.mesh),
+                                    rebuild_drift=cfg.updates.rebuild_drift,
+                                    nlist=cfg.serve.nlist,
+                                    iters=cfg.serve.kmeans_iters,
+                                    init=cfg.serve.kmeans_init)
+        print(json.dumps({
+            "store": store_dir, "vectors": store.num_vectors,
+            "store_generation": store.generation,
+            "nlist": idx.nlist, "imbalance": idx.imbalance,
+            "index_generation": idx.index_generation,
+            **info, "fault_counters": faults.counters()}, sort_keys=True))
         return
 
     if args.command == "init-store":
@@ -332,6 +373,56 @@ def main(argv=None) -> None:
                               "tokenize_workers": cfg.data.tokenize_workers,
                               "stages": prof.summary(),
                               "fault_counters": faults.counters()}))
+    elif args.command == "append":
+        # Live corpus update (docs/UPDATES.md): embed everything past the
+        # store's append cursor — grow the corpus first, e.g.
+        # --set data.num_pages=<new total> — into a fresh generation, with
+        # optional deletions (--tombstone) and in-place page updates
+        # (--update-ids), then bring the IVF index up to date when one
+        # exists. Serving processes pick the generation up via refresh().
+        if pc > 1:
+            raise SystemExit("append is a single-process job (one "
+                             "generation writer); run it on one host")
+        from dnn_page_vectors_tpu.updates import append_corpus
+        from dnn_page_vectors_tpu.utils.logging import MetricsLogger
+        try:
+            store = VectorStore(store_dir)
+        except FileNotFoundError:
+            raise SystemExit(f"no store at {store_dir}; run 'embed' before "
+                             "appending")
+        if store.manifest.get("model_step") != model_step:
+            raise SystemExit(
+                f"store at {store_dir} is stamped for model step "
+                f"{store.manifest.get('model_step')} but the checkpoint is "
+                f"at {model_step}; appended vectors must share the base "
+                "params — re-run 'embed' (full re-embed) instead")
+        tomb = [int(x) for x in (args.tombstone or "").split(",")
+                if x.strip()]
+        upd = [int(x) for x in (args.update_ids or "").split(",")
+               if x.strip()]
+        with maybe_profile(args.profile, cfg.workdir):
+            stats = append_corpus(embedder, trainer.corpus, store,
+                                  tombstone=tomb, update_ids=upd,
+                                  log=MetricsLogger(cfg.workdir, echo=False))
+        index_info = None
+        from dnn_page_vectors_tpu.index.ivf import (
+            MANIFEST as _IVF_MANIFEST, IVFIndex, index_dir)
+        if cfg.updates.auto_update_index and os.path.exists(
+                os.path.join(index_dir(store), _IVF_MANIFEST)):
+            try:
+                _, index_info = IVFIndex.update(
+                    store, embedder.mesh,
+                    rebuild_drift=cfg.updates.rebuild_drift,
+                    nlist=cfg.serve.nlist, iters=cfg.serve.kmeans_iters,
+                    init=cfg.serve.kmeans_init)
+            except Exception as e:  # append succeeded; index refresh didn't
+                index_info = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps({"store": store_dir,
+                          "store_generation": store.generation,
+                          "store_vectors": store.num_vectors, **stats,
+                          "index_update": index_info,
+                          "fault_counters": faults.counters()},
+                         sort_keys=True))
     elif args.command == "eval":
         from dnn_page_vectors_tpu.evals.recall import evaluate_recall
         store = VectorStore(store_dir)
@@ -397,6 +488,13 @@ def main(argv=None) -> None:
             for line in sys.stdin:
                 query = line.strip()
                 if not query:
+                    continue
+                if query == ":refresh":
+                    # zero-downtime hot-swap to the store's current
+                    # generation (after an out-of-process `append`):
+                    # in-flight queries finish on the old view
+                    print(json.dumps({"refreshed": svc.refresh()},
+                                     sort_keys=True), flush=True)
                     continue
                 print(json.dumps({"query": query,
                                   "results": svc.search(query, k=k)}),

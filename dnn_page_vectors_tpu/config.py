@@ -181,6 +181,27 @@ class ServeConfig:
     nlist: int = 0
     # k-means iterations for the IVF coarse quantizer build.
     kmeans_iters: int = 8
+    # Quantizer seeding: "kmeans++" (D²-spread seeds — lower list
+    # imbalance at large nlist; the build JSON reports the init->final
+    # imbalance delta) or "random" (uniform pool draw). Both seeded and
+    # byte-deterministic.
+    kmeans_init: str = "kmeans++"
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdatesConfig:
+    """Live corpus updates (dnn_page_vectors_tpu/updates/,
+    docs/UPDATES.md): append-only store generations, incremental IVF
+    refresh, zero-downtime serving hot-swap."""
+    # Full-rebuild trigger for IVFIndex.update: when the fraction of the
+    # corpus appended since the last full k-means exceeds this, the
+    # incremental posting append stops (stale centroids mis-assign enough
+    # new rows to erode recall) and update() runs a fresh build instead.
+    rebuild_drift: float = 0.25
+    # SearchService.refresh() / `cli append` bring the IVF index up to
+    # date automatically when one exists. False = store-only refresh
+    # (the index goes stale and serving falls back to exact, visibly).
+    auto_update_index: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -208,6 +229,7 @@ class Config:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    updates: UpdatesConfig = dataclasses.field(default_factory=UpdatesConfig)
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     workdir: str = "/tmp/dnn_page_vectors_tpu"
 

@@ -29,6 +29,15 @@ raises `IndexUnavailable`; a corrupt file is quarantined (renamed aside,
 counted in the fault counters) and the index reports unavailable — callers
 (SearchService, eval, mine) fall back to the exact brute-force path
 per request, visibly, and `cli index` rebuilds.
+
+Live updates (docs/UPDATES.md): a store APPEND (new generation of shards)
+makes the recorded table a strict subset of the live one — `update()`
+extends the index in O(new shards) by assigning only the unrecorded shards
+to the existing centroids and appending their posting files, until the
+drift (corpus fraction appended since the last full k-means,
+`updates.rebuild_drift`) forces a fresh build. Tombstoned rows stay in
+their posting lists; the store's read-time id masking turns them into
+dead (-1) candidates the re-rank already drops.
 """
 from __future__ import annotations
 
@@ -143,32 +152,27 @@ class IVFIndex:
     def imbalance(self) -> float:
         return float(self.manifest.get("imbalance", 0.0))
 
+    @property
+    def index_generation(self) -> int:
+        """Incremental updates applied since the last full k-means build
+        (0 = freshly built; docs/UPDATES.md)."""
+        return int(self.manifest.get("index_generation", 0))
+
     # -- build -------------------------------------------------------------
-    @classmethod
-    def build(cls, store, mesh, nlist: int = 0, iters: int = 8,
-              seed: int = 0, chunk: int = 8192,
-              sample_per_shard: Optional[int] = None) -> "IVFIndex":
-        """Train the quantizer, assign every store row, and persist the
-        inverted file next to the store (atomic manifest last, so a crash
-        mid-build leaves the previous index or none — never a torn one
-        that passes verification)."""
-        t0 = time.perf_counter()
-        N = store.num_vectors
-        if N == 0:
-            raise ValueError("cannot build an IVF index over an empty store")
-        nlist = int(nlist) if nlist and nlist > 0 else auto_nlist(N)
-        nlist = min(nlist, N)
-        centroids, kstats = train_kmeans(
-            store, mesh, nlist, iters=iters, seed=seed, chunk=chunk,
-            sample_per_shard=sample_per_shard)
-        d = index_dir(store)
-        os.makedirs(d, exist_ok=True)
-        cb, cc = _write_npy(os.path.join(d, "centroids.npy"), centroids)
+    @staticmethod
+    def _assign_postings(d: str, store, mesh, centroids: np.ndarray,
+                         entries, chunk: int):
+        """Assign `entries`' rows to `centroids` and persist their CSR
+        posting files. Returns (shards_meta, postings, sizes [nlist]) for
+        exactly those entries — build runs it over the whole store,
+        update() over only the new generation's shards."""
+        nlist = centroids.shape[0]
         shards_meta = []
         postings: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         sizes = np.zeros((nlist,), np.int64)
+        nonzero = [e for e in entries if e["count"] > 0]
         for entry, assign in assign_store(store, mesh, centroids,
-                                          chunk=chunk):
+                                          chunk=chunk, entries=nonzero):
             order = np.argsort(assign, kind="stable").astype(np.int32)
             counts = np.bincount(assign, minlength=nlist)
             offsets = np.zeros((nlist + 1,), np.int64)
@@ -185,9 +189,34 @@ class IVFIndex:
             postings[entry["index"]] = (order, offsets)
         # zero-count shards carry no postings but must stay in the recorded
         # table, or open() would read an honest store change into them
-        for entry in store.shards():
+        for entry in entries:
             if entry["count"] == 0:
                 shards_meta.append({"index": entry["index"], "count": 0})
+        return shards_meta, postings, sizes
+
+    @classmethod
+    def build(cls, store, mesh, nlist: int = 0, iters: int = 8,
+              seed: int = 0, chunk: int = 8192,
+              sample_per_shard: Optional[int] = None,
+              init: str = "kmeans++") -> "IVFIndex":
+        """Train the quantizer, assign every store row, and persist the
+        inverted file next to the store (atomic manifest last, so a crash
+        mid-build leaves the previous index or none — never a torn one
+        that passes verification)."""
+        t0 = time.perf_counter()
+        N = store.num_vectors
+        if N == 0:
+            raise ValueError("cannot build an IVF index over an empty store")
+        nlist = int(nlist) if nlist and nlist > 0 else auto_nlist(N)
+        nlist = min(nlist, N)
+        centroids, kstats = train_kmeans(
+            store, mesh, nlist, iters=iters, seed=seed, chunk=chunk,
+            sample_per_shard=sample_per_shard, init=init)
+        d = index_dir(store)
+        os.makedirs(d, exist_ok=True)
+        cb, cc = _write_npy(os.path.join(d, "centroids.npy"), centroids)
+        shards_meta, postings, sizes = cls._assign_postings(
+            d, store, mesh, centroids, store.shards(), chunk)
         shards_meta.sort(key=lambda s: s["index"])
         imbalance = float(nlist * np.square(sizes, dtype=np.float64).sum()
                           / max(N, 1) ** 2)
@@ -196,13 +225,120 @@ class IVFIndex:
             "dtype": store.manifest["dtype"],
             "model_step": store.model_step, "seed": int(seed),
             "iters": kstats["iters"], "reseeded": kstats["reseeded"],
+            "init": kstats["init"],
+            "init_imbalance": kstats["init_imbalance"],
             "num_vectors": int(N), "imbalance": round(imbalance, 4),
+            # live-update bookkeeping (docs/UPDATES.md): rows covered by
+            # the last full k-means vs rows appended incrementally since —
+            # their ratio is the drift that triggers the next full rebuild
+            "built_num_vectors": int(N),
+            "appended_since_build": 0,
+            "index_generation": 0,
             "build_seconds": round(time.perf_counter() - t0, 3),
             "centroids": {"file": "centroids.npy", "bytes": cb, "crc": cc},
             "shards": shards_meta,
         }
         _atomic_dump(manifest, os.path.join(d, MANIFEST))
         return cls(store, manifest, centroids, postings)
+
+    # -- incremental update (docs/UPDATES.md) ------------------------------
+    @classmethod
+    def update(cls, store, mesh, rebuild_drift: float = 0.25,
+               nlist: int = 0, iters: int = 8, seed: Optional[int] = None,
+               chunk: int = 8192, init: str = "kmeans++"
+               ) -> Tuple["IVFIndex", Dict]:
+        """Bring the persisted index up to date with the store after an
+        append: assign ONLY the shards the recorded table doesn't know to
+        the EXISTING centroids and append their posting files — O(new
+        shards), not O(corpus) — then atomically re-dump the manifest.
+
+        Falls back to a FULL rebuild (fresh k-means) when the existing
+        index can't be extended: missing/torn/corrupt files, a model-step
+        re-stamp, a recorded shard that changed or vanished (quarantine /
+        re-embed), or accumulated drift — the fraction of the corpus
+        appended since the last full k-means — crossing `rebuild_drift`
+        (stale centroids mis-assign enough new rows to erode recall).
+
+        Returns (index, info) where info["action"] is "noop" |
+        "incremental" | "rebuild" plus the decision inputs, so callers
+        (SearchService.refresh, cli refresh, bench) can count
+        incremental_updates vs full_rebuilds. Raises (IOError etc.) only
+        when the write path itself fails — the manifest is untouched then,
+        so readers keep the previous index generation."""
+        t0 = time.perf_counter()
+        d = index_dir(store)
+        mpath = os.path.join(d, MANIFEST)
+
+        def _rebuild(reason: str) -> Tuple["IVFIndex", Dict]:
+            idx = cls.build(store, mesh, nlist=nlist, iters=iters,
+                            seed=0 if seed is None else seed, chunk=chunk,
+                            init=init)
+            faults.count("index_full_rebuilds")
+            return idx, {"action": "rebuild", "reason": reason,
+                         "seconds": round(time.perf_counter() - t0, 3)}
+
+        if not os.path.exists(mpath):
+            return _rebuild("no index on disk")
+        try:
+            with open(mpath) as f:
+                man = json.load(f)
+        except (json.JSONDecodeError, ValueError):
+            return _rebuild("torn index manifest")
+        if (man.get("model_step") != store.model_step
+                or man.get("dim") != store.dim):
+            return _rebuild("model step / dim changed")
+        live = store.shards()
+        live_by_idx = {s["index"]: s["count"] for s in live}
+        recorded = {s["index"]: s["count"] for s in man.get("shards", [])}
+        if any(recorded.get(i) != c for i, c in live_by_idx.items()
+               if i in recorded) or any(i not in live_by_idx
+                                        for i in recorded):
+            return _rebuild("recorded shards changed (quarantine/re-embed)")
+        new_entries = [e for e in live if e["index"] not in recorded]
+        if not new_entries:
+            return (cls.open(store),
+                    {"action": "noop",
+                     "seconds": round(time.perf_counter() - t0, 3)})
+        try:
+            cls._verify_files(d, man)      # don't extend corrupt postings
+        except IndexUnavailable as e:
+            return _rebuild(f"existing index unhealthy ({e})")
+        total = store.num_vectors
+        appended = (int(man.get("appended_since_build", 0))
+                    + sum(e["count"] for e in new_entries))
+        drift = appended / max(total, 1)
+        if drift > rebuild_drift:
+            return _rebuild(
+                f"drift {drift:.3f} > rebuild_drift {rebuild_drift}")
+        centroids = np.asarray(
+            np.load(os.path.join(d, man["centroids"]["file"])), np.float32)
+        new_meta, _, new_sizes = cls._assign_postings(
+            d, store, mesh, centroids, new_entries, chunk)
+        man["shards"] = sorted(man["shards"] + new_meta,
+                               key=lambda s: s["index"])
+        man["num_vectors"] = int(total)
+        man["appended_since_build"] = appended
+        man["index_generation"] = int(man.get("index_generation", 0)) + 1
+        # imbalance over the FULL posting set: old sizes from the small
+        # [nlist+1] offset files, new from the assignment just done
+        sizes = new_sizes.astype(np.float64)
+        for s in man["shards"]:
+            if s["count"] == 0 or s["index"] in {m["index"]
+                                                 for m in new_meta}:
+                continue
+            off = np.load(os.path.join(d, s["off"]))
+            sizes += np.diff(off)
+        man["imbalance"] = round(
+            float(man["nlist"] * np.square(sizes).sum()
+                  / max(total, 1) ** 2), 4)
+        _atomic_dump(man, mpath)
+        faults.count("index_incremental_updates")
+        return (cls.open(store, verify=False),
+                {"action": "incremental", "new_shards": len(new_entries),
+                 "appended_rows": sum(e["count"] for e in new_entries),
+                 "drift": round(drift, 4),
+                 "index_generation": man["index_generation"],
+                 "seconds": round(time.perf_counter() - t0, 3)})
 
     # -- open / verify -----------------------------------------------------
     @classmethod
@@ -297,7 +433,10 @@ class IVFIndex:
         centroid across every shard, at STORED width (int8 codes / fp16
         rows straight off the mmap — the rerank matmul widens on device).
         Returns (vecs [C, D], scales [C]|None, page_ids [C] i64,
-        cand_cent [C] i32)."""
+        cand_cent [C] i32). Tombstoned rows (id -1 after the store's
+        read-time masking, docs/UPDATES.md) get centroid -2 — matching no
+        probed list — so a dead vector can never OCCUPY a top-k slot, not
+        merely be filtered after winning one."""
         v_parts, s_parts, i_parts, c_parts = [], [], [], []
         for sidx in sorted(self._postings):
             order, offsets = self._postings[sidx]
@@ -307,11 +446,13 @@ class IVFIndex:
                 continue
             take = np.concatenate(rows)
             ids, vecs, scl = self._shard_raw(sidx)
+            taken_ids = np.asarray(ids[take], np.int64)
             v_parts.append(np.asarray(vecs[take]))
-            i_parts.append(np.asarray(ids[take], np.int64))
+            i_parts.append(taken_ids)
             if scl is not None:
                 s_parts.append(np.asarray(scl[take]))
-            c_parts.append(np.repeat(cents.astype(np.int32), lens))
+            cent = np.repeat(cents.astype(np.int32), lens)
+            c_parts.append(np.where(taken_ids >= 0, cent, np.int32(-2)))
         if not v_parts:
             return (np.zeros((0, self.store.dim), np.float16), None,
                     np.zeros((0,), np.int64), np.zeros((0,), np.int32))

@@ -128,6 +128,34 @@ def _normalize(c: np.ndarray) -> np.ndarray:
     return (c / np.maximum(n, 1e-12)).astype(np.float32)
 
 
+def _kmeans_pp(pool: np.ndarray, nlist: int,
+               rng: np.random.Generator) -> np.ndarray:
+    """Seeded k-means++ (Arthur & Vassilvitskii 2007) over the sampled
+    pool: each next seed is drawn with probability proportional to its
+    cosine distance from the nearest already-chosen seed, so seeds spread
+    across the data instead of clumping where the density is — measurably
+    lower list imbalance at large nlist than uniform seeding (the ROADMAP
+    open item; `init_imbalance` in the build stats shows the delta).
+    Incremental O(nlist * pool * D): one pool-vs-new-seed matvec per seed,
+    never a full distance matrix. Deterministic for a given (pool, rng
+    state); an already-chosen row has distance 0 and is never re-drawn."""
+    n = pool.shape[0]
+    out = np.empty((nlist, pool.shape[1]), np.float32)
+    first = int(rng.integers(0, n))
+    out[0] = pool[first]
+    best = pool @ out[0]                     # nearest-seed cosine sim [n]
+    for j in range(1, nlist):
+        d = np.maximum(1.0 - best, 0.0)      # cosine distance to nearest
+        total = d.sum()
+        if total <= 0.0:                     # degenerate pool: uniform draw
+            nxt = int(rng.integers(0, n))
+        else:
+            nxt = int(rng.choice(n, p=d / total))
+        out[j] = pool[nxt]
+        best = np.maximum(best, pool @ out[j])
+    return out
+
+
 def sample_rows(store, n: int, seed: int) -> np.ndarray:
     """Seeded deterministic sample of up to `n` dequantized f32 rows,
     proportional per shard, in (shard, row) order — the k-means init set
@@ -155,13 +183,23 @@ def _padded_rows(store, mesh: Mesh) -> int:
 
 
 def _iter_staged(store, mesh: Mesh, rows: int, sample_per_shard=None,
-                 rng_key=None):
+                 rng_key=None, entries=None):
     """Yield (entry, valid_n, pages, scales) for every non-empty shard,
     staged at stored width. With `sample_per_shard`, a seeded per-shard row
-    subset (the mini-batch) is staged instead of the full shard."""
-    entries = store.shards()
-    for entry, (ids, vecs, scl) in zip(
-            entries, store.iter_shards(raw=True, prefetch=1)):
+    subset (the mini-batch) is staged instead of the full shard. `entries`
+    restricts the sweep to a shard subset (the incremental index update's
+    O(new shards) path); disk reads run one shard ahead on a reader
+    thread either way."""
+    from dnn_page_vectors_tpu.infer.vector_store import read_ahead
+    entries = store.shards() if entries is None else entries
+
+    def _load():
+        for e in entries:
+            ids, vecs, scl = store._load_entry(e, raw=True)
+            yield e, np.asarray(vecs), (None if scl is None
+                                        else np.asarray(scl))
+
+    for entry, vecs, scl in read_ahead(_load(), depth=1):
         n = vecs.shape[0]
         if n == 0:
             continue
@@ -179,20 +217,33 @@ def _iter_staged(store, mesh: Mesh, rows: int, sample_per_shard=None,
 def train_kmeans(store, mesh: Mesh, nlist: int, iters: int = 8,
                  seed: int = 0, chunk: int = 8192,
                  sample_per_shard: Optional[int] = None,
-                 init_sample: int = 65_536) -> Tuple[np.ndarray, Dict]:
+                 init_sample: int = 65_536,
+                 init: str = "kmeans++") -> Tuple[np.ndarray, Dict]:
     """Train `nlist` unit-norm centroids over the store. Returns
     (centroids [nlist, D] f32, stats). Deterministic for a given
-    (store bytes, seed, mesh, backend)."""
+    (store bytes, seed, mesh, backend, init). `init` is "kmeans++"
+    (default: D²-spread seeds, lower imbalance at large nlist) or
+    "random" (uniform pool draw, the pre-update behavior); stats record
+    `init_imbalance` — the faiss imbalance factor of the FIRST assignment
+    pass — next to the final one so the seeding's contribution is
+    measurable (`cli index` reports the delta)."""
     N = store.num_vectors
     if N == 0:
         raise ValueError("cannot train k-means over an empty store")
     nlist = int(min(max(1, nlist), N))
     pool = sample_rows(store, max(nlist, min(init_sample, N)), seed)
     rng = np.random.default_rng(seed)
-    centroids = _normalize(
-        pool[rng.choice(pool.shape[0], size=nlist, replace=False)])
+    if init == "kmeans++":
+        centroids = _normalize(_kmeans_pp(pool, nlist, rng))
+    elif init == "random":
+        centroids = _normalize(
+            pool[rng.choice(pool.shape[0], size=nlist, replace=False)])
+    else:
+        raise ValueError(f"unknown k-means init {init!r} "
+                         "(want kmeans++ or random)")
     rows = _padded_rows(store, mesh)
     reseeded = 0
+    init_imbalance = 0.0
     for it in range(max(1, iters)):
         sums = np.zeros((nlist, store.dim), np.float64)
         counts = np.zeros((nlist,), np.float64)
@@ -204,6 +255,10 @@ def train_kmeans(store, mesh: Mesh, nlist: int, iters: int = 8,
                                  chunk=chunk)
             sums += np.asarray(s, np.float64)
             counts += np.asarray(c, np.float64)
+        if it == 0:                    # seeding quality, before any update
+            tot = counts.sum()
+            init_imbalance = float(nlist * np.square(counts).sum()
+                                   / max(tot, 1.0) ** 2)
         new = centroids.astype(np.float64).copy()
         nz = counts > 0
         new[nz] = sums[nz] / counts[nz, None]
@@ -214,21 +269,26 @@ def train_kmeans(store, mesh: Mesh, nlist: int, iters: int = 8,
             reseeded += int(empty.size)
         centroids = _normalize(new.astype(np.float32))
     return centroids, {"nlist": nlist, "iters": int(max(1, iters)),
-                       "reseeded": reseeded,
+                       "reseeded": reseeded, "init": init,
+                       "init_imbalance": round(init_imbalance, 4),
                        "trained_rows": int(N if sample_per_shard is None
                                            else min(N, sample_per_shard
                                                     * len(store.shards())))}
 
 
 def assign_store(store, mesh: Mesh, centroids: np.ndarray,
-                 chunk: int = 8192) -> Iterator[Tuple[Dict, np.ndarray]]:
+                 chunk: int = 8192, entries=None
+                 ) -> Iterator[Tuple[Dict, np.ndarray]]:
     """Final assignment sweep: yield (shard entry, assign [count] i32) for
     every non-empty shard, streaming one shard at a time through the same
-    compiled pass the trainer used (sums/counts are discarded)."""
+    compiled pass the trainer used (sums/counts are discarded). `entries`
+    restricts the sweep to a shard subset — the incremental index update
+    assigns ONLY the new generation's shards this way (docs/UPDATES.md)."""
     nlist = centroids.shape[0]
     rows = _padded_rows(store, mesh)
     cdev = jnp.asarray(centroids, jnp.float32)
-    for entry, n, pages, scales in _iter_staged(store, mesh, rows):
+    for entry, n, pages, scales in _iter_staged(store, mesh, rows,
+                                                entries=entries):
         _, _, assign = shard_pass(pages, scales, n, cdev, mesh, nlist,
                                   chunk=chunk)
         yield entry, np.asarray(assign, np.int32)[:n]

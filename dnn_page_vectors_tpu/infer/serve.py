@@ -44,6 +44,17 @@ page vectors never touch disk. Oversized stores transparently fall back to
 the streaming path (ops/topk.py:topk_over_store) — same results, per-query
 disk reads double-buffered behind a reader thread.
 
+Live updates (docs/UPDATES.md): everything a corpus update can change —
+the store handle with its generation chain and tombstones, the staged HBM
+shards, the id table, the IVF index — lives in ONE immutable view object
+(`_ServeView`). `refresh()` builds the next view off to the side (restaging
+only the appended shards, updating the index incrementally) and publishes
+it with a single reference assignment: in-flight search_many buckets
+finish on the view they captured, the next bucket sees the new corpus —
+zero downtime, no dropped futures, never a mixed result set. metrics()
+reports `store_generation` / `index_generation` / `docs_appended` /
+`tombstoned` / `incremental_updates` / `full_rebuilds`.
+
 Degradation (docs/ROBUSTNESS.md): a shard that FAILS to stage — an I/O
 fault during the device_put, a checksum mismatch, or the HBM budget
 overrunning mid-stage — does not kill the service. Checksum failures are
@@ -156,6 +167,39 @@ class _MicroBatcher:
         self._t.join()
 
 
+class _ServeView:
+    """One atomic serving snapshot (docs/UPDATES.md): everything
+    search_many touches that a refresh() can change — the store handle
+    (with its frozen generation chain and tombstone map), the staged HBM
+    shards, the combined-id table, the device merge program, the
+    degraded-tail entries, and the IVF index. The hot-swap is a single
+    reference assignment: in-flight dispatches finish on the view they
+    captured at entry, the next dispatch sees the new one — no lock on
+    the query path, no torn half-view ever observable."""
+
+    __slots__ = ("store", "entries", "generation", "shards", "shard_keys",
+                 "stream_entries", "pid_table", "merge", "pad_rows",
+                 "index", "index_error", "index_info", "docs_appended",
+                 "tombstoned", "num_vectors")
+
+    def __init__(self, store: VectorStore):
+        self.store = store
+        self.entries: List[Dict] = store.shards()   # frozen table snapshot
+        self.generation = store.generation
+        self.docs_appended = store.appended_vectors()
+        self.tombstoned = store.tombstoned_count()
+        self.num_vectors = store.num_vectors
+        self.shards = None   # [(ids np[int64], n, pages [R, D], scl|None)]
+        self.shard_keys: List[tuple] = []
+        self.stream_entries: List[Dict] = []
+        self.pid_table = None
+        self.merge = None
+        self.pad_rows = 0
+        self.index = None
+        self.index_error: Optional[str] = None
+        self.index_info: Optional[Dict] = None
+
+
 class SearchService:
     def __init__(self, cfg, embedder: BulkEmbedder, corpus,
                  store: VectorStore, preload_hbm_gb: float = 4.0,
@@ -168,7 +212,6 @@ class SearchService:
         self.snippet_chars = snippet_chars
         self.degraded = False
         self.fault_counters: Dict[str, int] = {}
-        self._stream_entries: List[Dict] = []
         # per-stage serving breakdown (queue_wait/tokenize/encode/topk/
         # merge/format) — one shared instance; the batcher and concurrent
         # callers all add into it
@@ -192,13 +235,19 @@ class SearchService:
                              if serve_cfg is not None else "exact")
         self._nprobe = (getattr(serve_cfg, "nprobe", 8)
                         if serve_cfg is not None else 8)
-        self._index = None
-        self._index_error: Optional[str] = None
+        upd_cfg = getattr(cfg, "updates", None)
+        self._rebuild_drift = (getattr(upd_cfg, "rebuild_drift", 0.25)
+                               if upd_cfg is not None else 0.25)
+        self._auto_update_index = (
+            getattr(upd_cfg, "auto_update_index", True)
+            if upd_cfg is not None else True)
         self.ann_lists_scanned = 0
         self.ann_candidates_reranked = 0
         self.ann_fallbacks = 0
-        if self._serve_index == "ivf":
-            self._open_index()
+        # live-update counters (docs/UPDATES.md)
+        self.refreshes = 0
+        self.incremental_updates = 0
+        self.full_rebuilds = 0
         self._batcher: Optional[_MicroBatcher] = None
         self._batch_sizes: List[int] = []   # telemetry after close()
         self._log = log
@@ -214,69 +263,170 @@ class SearchService:
         self._n_data = n_data
         self.query_batch = query_batch or -(-8 // n_data) * n_data
         self.warm_latency_ms: Optional[float] = None
-        self._shards = None  # [(ids np[int64], n, pages [R, D], scl|None)]
-        # Budget against the ACTUAL device footprint: every shard is padded
-        # to the max shard row count for one static compiled shape, so an
-        # uneven store (merged multi-writer shards) costs
-        # n_shards * padded_rows, which can far exceed num_vectors.
-        entries = store.shards()
-        rows = max((s["count"] for s in entries), default=0)
-        rows += (-rows) % n_data
-        self._pad_rows = rows
-        # budget is PER DEVICE: shards are row-sharded over 'data', so each
-        # device holds rows/n_data of every staged shard (ADVICE r4) — at
-        # the STORED width (fp16 rows, or int8 codes + fp16 scale per row)
-        per_row = (store.dim + 2 if store.manifest["dtype"] == "int8"
-                   else store.dim * 2)
-        need = len(entries) * rows * per_row / n_data
-        # rows > 0: a store of only zero-count shards has nothing to stage
-        # (need == 0 would pass even the explicit never-preload 0.0 budget)
-        if entries and rows > 0 and need <= preload_hbm_gb * 2**30:
-            self._preload(rows, budget_bytes=preload_hbm_gb * 2**30,
-                          per_row=per_row)
-            if not self._shards:      # nothing survived staging
-                self._shards = None   # stream instead; handles empty stores
+        self._preload_gb = preload_hbm_gb
+        self._refresh_lock = threading.Lock()   # one refresh at a time
+        self._view = self._build_view(store)
         if log is not None:
+            view = self._view
             log.write({
                 "serve_degraded": self.degraded,
-                "serve_hbm_shards": len(self._shards or []),
-                "serve_stream_shards": len(self._stream_entries),
-                "serve_vectors": store.num_vectors,
+                "serve_hbm_shards": len(view.shards or []),
+                "serve_stream_shards": len(view.stream_entries),
+                "serve_vectors": view.num_vectors,
                 "serve_query_batch": self.query_batch,
                 "serve_query_cache_size": self._cache_cap,
                 "serve_index": self._serve_index,
-                "serve_ann_available": self._index is not None,
+                "serve_ann_available": view.index is not None,
+                "store_generation": view.generation,
                 "fault_counters": faults.counters(),
             })
 
     @property
     def preloaded(self) -> bool:
-        return self._shards is not None
+        return self._view.shards is not None
+
+    # read-only compatibility windows into the current view (tests and
+    # telemetry peek at these; the query path captures the view ONCE)
+    @property
+    def _shards(self):
+        return self._view.shards
+
+    @property
+    def _stream_entries(self) -> List[Dict]:
+        return self._view.stream_entries
+
+    @property
+    def _index(self):
+        return self._view.index
+
+    @property
+    def _index_error(self) -> Optional[str]:
+        return self._view.index_error
 
     def _count_fault(self, name: str) -> None:
         self.fault_counters[name] = self.fault_counters.get(name, 0) + 1
         faults.count(name)
 
-    # -- IVF ANN index (docs/ANN.md) ---------------------------------------
-    def _open_index(self) -> None:
+    # -- hot-swap refresh (docs/UPDATES.md) --------------------------------
+    def refresh(self, update_index: Optional[bool] = None) -> Dict:
+        """Swap in the store's CURRENT generation chain with zero downtime:
+        re-open the store (fresh handle — the serving view's generations
+        and tombstones are frozen per view, so in-flight queries never see
+        a half-applied update), restage only the shards the old view
+        doesn't already hold on device, bring the IVF index up to date
+        (incremental posting append, or drift-triggered full rebuild —
+        `update_index` overrides updates.auto_update_index), and publish
+        the new view with one atomic reference assignment between
+        micro-batcher dispatches. Queries keep flowing the whole time:
+        buckets in flight finish on the old view, the next bucket sees the
+        new one, and a failed index update degrades THAT view to exact
+        search instead of taking the service down."""
+        t0 = time.perf_counter()
+        with self._refresh_lock:
+            old = self._view
+            # fresh handle: verify() gates appended bytes exactly like the
+            # base open did, and the old view's store object stays frozen
+            new_store = VectorStore(self.store.directory)
+            upd = (self._auto_update_index if update_index is None
+                   else update_index)
+            view = self._build_view(new_store, reuse=old,
+                                    update_index=upd)
+            t_swap = time.perf_counter()
+            self._view = view        # THE swap: one reference assignment
+            self.store = new_store
+            self.refreshes += 1
+        swap_ms = (time.perf_counter() - t_swap) * 1000.0
+        info = {
+            "store_generation": view.generation,
+            "index_generation": (view.index.index_generation
+                                 if view.index is not None else None),
+            "docs_appended": view.docs_appended,
+            "new_docs": view.docs_appended - old.docs_appended,
+            "tombstoned": view.tombstoned,
+            "vectors": view.num_vectors,
+            "hbm_shards": len(view.shards or []),
+            "stream_shards": len(view.stream_entries),
+            "refresh_seconds": round(time.perf_counter() - t0, 3),
+            "swap_ms": round(swap_ms, 3),
+        }
+        if view.index_info is not None:
+            info["index_update"] = view.index_info
+        if view.index_error is not None:
+            info["index_error"] = view.index_error
+        if self._log is not None:
+            self._log.write({"serve_refresh": self.refreshes, **info})
+        return info
+
+    def _build_view(self, store: VectorStore, reuse: "_ServeView" = None,
+                    update_index: bool = False) -> "_ServeView":
+        view = _ServeView(store)
+        # Budget against the ACTUAL device footprint: every shard is padded
+        # to the max shard row count for one static compiled shape, so an
+        # uneven store (merged multi-writer shards) costs
+        # n_shards * padded_rows, which can far exceed num_vectors.
+        rows = max((s["count"] for s in view.entries), default=0)
+        rows += (-rows) % self._n_data
+        view.pad_rows = rows
+        # budget is PER DEVICE: shards are row-sharded over 'data', so each
+        # device holds rows/n_data of every staged shard (ADVICE r4) — at
+        # the STORED width (fp16 rows, or int8 codes + fp16 scale per row)
+        per_row = (store.dim + 2 if store.manifest["dtype"] == "int8"
+                   else store.dim * 2)
+        need = len(view.entries) * rows * per_row / self._n_data
+        # rows > 0: a store of only zero-count shards has nothing to stage
+        # (need == 0 would pass even the explicit never-preload 0.0 budget)
+        if view.entries and rows > 0 and need <= self._preload_gb * 2**30:
+            self._stage_view(view, rows,
+                             budget_bytes=self._preload_gb * 2**30,
+                             per_row=per_row, reuse=reuse)
+            if not view.shards:       # nothing survived staging
+                view.shards = None    # stream instead; handles empty stores
+        if self._serve_index == "ivf":
+            self._attach_index(view, update_index)
+        return view
+
+    # -- IVF ANN index (docs/ANN.md, docs/UPDATES.md) ----------------------
+    def _attach_index(self, view: "_ServeView", update_index: bool) -> None:
         from dnn_page_vectors_tpu.index.ivf import IndexUnavailable, IVFIndex
         try:
-            self._index = IVFIndex.open(self.store)
-            self._index_error = None
+            if update_index:
+                serve_cfg = self.cfg.serve
+                view.index, view.index_info = IVFIndex.update(
+                    view.store, self.embedder.mesh,
+                    rebuild_drift=self._rebuild_drift,
+                    nlist=serve_cfg.nlist, iters=serve_cfg.kmeans_iters,
+                    init=getattr(serve_cfg, "kmeans_init", "kmeans++"))
+                action = view.index_info.get("action")
+                if action == "incremental":
+                    self.incremental_updates += 1
+                elif action == "rebuild":
+                    self.full_rebuilds += 1
+            else:
+                view.index = IVFIndex.open(view.store)
+            view.index_error = None
         except IndexUnavailable as e:
-            self._index = None
-            self._index_error = str(e)
+            view.index = None
+            view.index_error = str(e)
             faults.warn(f"IVF index unavailable ({e}); serving the exact "
                         "path per request")
+        except Exception as e:  # noqa: BLE001 — e.g. a posting-append
+            # fault mid-update: the on-disk manifest is untouched (it lands
+            # last), but it no longer matches the live table, so THIS view
+            # serves exact — visibly — until a later refresh/rebuild
+            view.index = None
+            view.index_error = f"{type(e).__name__}: {e}"
+            self._count_fault("serve_index_update_failures")
+            faults.warn(f"IVF index update failed ({view.index_error}); "
+                        "serving the exact path until a rebuild")
 
-    def _search_ann(self, qv: np.ndarray, n: int, k: int
+    def _search_ann(self, view: "_ServeView", qv: np.ndarray, n: int, k: int
                     ) -> Optional[List[List[Dict]]]:
         """ANN answer for `n` real queries, or None to fall back to the
-        exact path (index missing, stale against the store's CURRENT model
-        step, or failing at search time — the failure quarantine already
-        happened inside the index layer)."""
-        idx = self._index
-        if idx is None or idx.model_step != self.store.model_step:
+        exact path (index missing, stale against the view store's CURRENT
+        model step, or failing at search time — the failure quarantine
+        already happened inside the index layer)."""
+        idx = view.index
+        if idx is None or idx.model_step != view.store.model_step:
             return None
         prof = self.profiler
         try:
@@ -284,9 +434,9 @@ class SearchService:
                 scores, ids, st = idx.search(qv[:n], k=k,
                                              nprobe=self._nprobe)
         except Exception as e:  # noqa: BLE001 — any index failure degrades
-            self._index = None
-            self._index_error = f"{type(e).__name__}: {e}"
-            faults.warn(f"IVF search failed ({self._index_error}); "
+            view.index = None
+            view.index_error = f"{type(e).__name__}: {e}"
+            faults.warn(f"IVF search failed ({view.index_error}); "
                         "falling back to exact search")
             return None
         self.ann_lists_scanned += st.get("lists_scanned", 0)
@@ -294,27 +444,56 @@ class SearchService:
         with prof.stage("format"):
             return [self._format(scores[i], ids[i]) for i in range(n)]
 
-    def _preload(self, rows: int, budget_bytes: float, per_row: int) -> None:
+    def _stage_view(self, view: "_ServeView", rows: int,
+                    budget_bytes: float, per_row: int,
+                    reuse: "_ServeView" = None) -> None:
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         plan = faults.active()
-        staged = []
+        store = view.store
+        # restage only what the old view doesn't hold: appended generations
+        # arrive as NEW shard indices, so a refresh re-uses every already-
+        # staged device array (keyed on gen/index/count/crc) and pays
+        # device transfer for exactly the delta; ids reload host-side so
+        # newer tombstones re-mask rows the device copy still carries
+        reuse_map = {}
+        if (reuse is not None and reuse.shards
+                and reuse.pad_rows == rows):
+            reuse_map = {key: tup for key, tup
+                         in zip(reuse.shard_keys, reuse.shards)}
+        staged, keys = [], []
         used = 0.0
         per_shard = rows * per_row / self._n_data
-        for entry in self.store.shards():
+        for entry in view.entries:
             if entry["count"] == 0:   # zero-count shards hold nothing to score
                 continue
+            key = (entry.get("gen", 0), entry["index"], entry["count"],
+                   entry.get("crc", {}).get("vec"))
             try:
+                hit = reuse_map.get(key)
+                if hit is not None:
+                    old_ids, old_n, pages, scl = hit
+                    ids = store.load_ids(entry)
+                    ids = np.asarray(ids[ids >= 0], np.int64)
+                    # the device rows were compacted against the STAGING-
+                    # time tombstone set: reuse only when the masked ids
+                    # match exactly, else fall through and restage (a new
+                    # tombstone landed in this shard)
+                    if np.array_equal(ids, old_ids):
+                        staged.append((old_ids, old_n, pages, scl))
+                        keys.append(key)
+                        used += per_shard
+                        continue
                 plan.check("hbm_stage")
-                err = self.store.entry_error(entry)
+                err = store.entry_error(entry)
                 if err is not None:
                     # corrupt bytes must never reach the device: quarantine
                     # drops the shard from the table entirely (its id-range
                     # returns on the next embed resume), and this service
                     # serves without it — degraded, visibly
-                    self.store.quarantine(entry, err)
+                    store.quarantine(entry, err)
                     self._count_fault("serve_quarantined_shards")
                     self.degraded = True
                     continue
@@ -323,30 +502,48 @@ class SearchService:
                         f"HBM budget overrun mid-stage: shard "
                         f"{entry['index']} needs {per_shard:.0f} B on top of "
                         f"{used:.0f} staged (budget {budget_bytes:.0f})")
-                ids, vecs, scl = self.store._load_entry(entry, raw=True)
-                staged.append((np.asarray(ids, np.int64), vecs.shape[0],
-                               *stage_shard(vecs, rows, self.store.dim,
+                ids, vecs, scl = store._load_entry(entry, raw=True)
+                ids = np.asarray(ids, np.int64)
+                keep = ids >= 0
+                if not keep.all():
+                    # compact tombstoned rows out BEFORE the device copy: a
+                    # dead vector must not occupy a per-shard top-k slot
+                    # (the exact merge would drop it and return short)
+                    ids = ids[keep]
+                    vecs = np.asarray(vecs)[keep]
+                    scl = None if scl is None else np.asarray(scl)[keep]
+                staged.append((ids, int(ids.shape[0]),
+                               *stage_shard(vecs, rows, store.dim,
                                             self.embedder.mesh, scales=scl)))
+                keys.append(key)
                 used += per_shard
             except Exception as e:  # noqa: BLE001 — any staging failure
                 # (injected I/O fault, real device OOM, budget overrun)
                 # degrades THIS shard to the streaming path; the service
                 # stays up on the shards that did stage
-                self._stream_entries.append(entry)
+                view.stream_entries.append(entry)
                 self.degraded = True
                 self._count_fault("serve_stage_faults")
                 faults.warn(
                     f"HBM staging failed for shard {entry['index']} "
                     f"({type(e).__name__}: {e}); serving it via the "
                     "streaming path (degraded)")
-        self._shards = staged
+        view.shards = staged
+        view.shard_keys = keys
         if not staged:
             return
         # combined-id -> page-id table for the device-side merge below:
         # shard slot s, padded row r  ->  slot s * rows + r
-        self._pid_table = np.full((len(self._shards) * rows,), -1, np.int64)
-        for slot, (sids, n, _, _) in enumerate(self._shards):
-            self._pid_table[slot * rows: slot * rows + n] = sids
+        view.pid_table = np.full((len(staged) * rows,), -1, np.int64)
+        for slot, (sids, n, _, _) in enumerate(staged):
+            view.pid_table[slot * rows: slot * rows + n] = sids
+        if reuse is not None and reuse.merge is not None \
+                and reuse.pad_rows == rows:
+            # the merge program depends only on pad_rows (and retraces per
+            # candidate-list structure): reusing the jitted fn object keeps
+            # the XLA cache warm across refreshes
+            view.merge = reuse.merge
+            return
 
         def merge(cands):
             # Device-side cross-shard merge, output PACKED into one fp32
@@ -372,7 +569,7 @@ class SearchService:
             return jnp.concatenate(
                 [lax.bitcast_convert_type(top_s, jnp.int32), top_i], axis=1)
 
-        self._merge = jax.jit(merge)
+        view.merge = jax.jit(merge)
 
     # -- query-embedding cache --------------------------------------------
     @staticmethod
@@ -484,12 +681,24 @@ class SearchService:
     def metrics(self) -> Dict:
         """Serving counters + the per-stage breakdown, metrics-log ready."""
         total = self.cache_hits + self.cache_misses
+        view = self._view
         rec = {
             "serve_degraded": self.degraded,
             "serve_cache_hits": self.cache_hits,
             "serve_cache_misses": self.cache_misses,
             "serve_cache_hit_rate": round(self.cache_hits / total, 4)
             if total else 0.0,
+            # live-update state (docs/UPDATES.md): which store/index
+            # generation this service is answering from, and how it got
+            # there — always present so dashboards can alert on drift
+            "store_generation": view.generation,
+            "index_generation": (view.index.index_generation
+                                 if view.index is not None else None),
+            "docs_appended": view.docs_appended,
+            "tombstoned": view.tombstoned,
+            "refreshes": self.refreshes,
+            "incremental_updates": self.incremental_updates,
+            "full_rebuilds": self.full_rebuilds,
             **self.profiler.summary(prefix="serve_stage_"),
         }
         sizes = (self._batcher.batch_sizes if self._batcher is not None
@@ -558,26 +767,35 @@ class SearchService:
         n = len(queries)
         if n == 0:
             return []
+        # ONE view for the whole call (docs/UPDATES.md): a refresh() swap
+        # mid-call cannot mix generations inside a result set — this
+        # dispatch finishes on the view it captured, the next one sees the
+        # new view
+        view = self._view
         qv = self._embed_queries_cached(list(queries))
         prof = self.profiler
         if self._serve_index == "ivf":
-            res = self._search_ann(qv, n, k)
+            res = self._search_ann(view, qv, n, k)
             if res is not None:
                 return res
             # exact path serves this request; visible in metrics + counters
             self.ann_fallbacks += n
             faults.count("serve_ann_fallbacks", n)
         B = self.query_batch
-        if self._shards is None:
+        if view.shards is None:
             # streaming store: pad the query matrix to a bucket multiple so
             # every call reuses one compiled shape, then sweep disk ONCE
-            # for the whole list
+            # for the whole list. The sweep reads the VIEW's store handle —
+            # refresh() never mutates it (it opens a fresh handle for the
+            # next view), so a swap mid-sweep cannot mix generations, while
+            # an in-place store mutation (ensure_model_step under a live
+            # service) still propagates per request like it always did
             pad = (-n) % B
             if pad:
                 qv = np.concatenate(
                     [qv, np.zeros((pad, qv.shape[1]), np.float32)])
             with prof.stage("topk"):
-                scores, ids = topk_over_store(qv, self.store,
+                scores, ids = topk_over_store(qv, view.store,
                                               self.embedder.mesh, k=k,
                                               query_batch=B)
             with prof.stage("format"):
@@ -588,14 +806,15 @@ class SearchService:
         # and format in order. A >bucket batch therefore pipelines compute
         # against transfer instead of serializing dispatch/drain per
         # bucket.
-        pending = [self._dispatch_bucket(qv[s: s + B], k)
+        pending = [self._dispatch_bucket(view, qv[s: s + B], k)
                    for s in range(0, n, B)]
         out: List[List[Dict]] = []
         for nreal, q, packed in pending:
-            out.extend(self._collect_bucket(nreal, q, packed, k))
+            out.extend(self._collect_bucket(view, nreal, q, packed, k))
         return out
 
-    def _dispatch_bucket(self, qblock: np.ndarray, k: int):
+    def _dispatch_bucket(self, view: "_ServeView", qblock: np.ndarray,
+                         k: int):
         """HBM-resident fast path for ONE compiled bucket (<= query_batch
         real rows): every resident shard's top-k program dispatches under
         JAX's async queue and the cross-shard merge runs ON DEVICE; the
@@ -618,20 +837,20 @@ class SearchService:
             cands = [
                 sharded_topk(q, pages, self.embedder.mesh, k=k, valid=n,
                              scales=scl)
-                for _, n, pages, scl in self._shards]
-            packed = self._merge(cands)                # async, on device
+                for _, n, pages, scl in view.shards]
+            packed = view.merge(cands)                 # async, on device
         return nreal, q, packed
 
-    def _collect_bucket(self, nreal: int, q, packed, k: int
-                        ) -> List[List[Dict]]:
+    def _collect_bucket(self, view: "_ServeView", nreal: int, q, packed,
+                        k: int) -> List[List[Dict]]:
         prof = self.profiler
         with prof.stage("merge"):
             packed = np.asarray(packed)                # the one transfer
         top_s = np.ascontiguousarray(packed[:, :k]).view(np.float32)
         top_i = packed[:, k:]
         pids = np.where(top_i >= 0,
-                        self._pid_table[np.clip(top_i, 0, None)], -1)
-        if not self._stream_entries:
+                        view.pid_table[np.clip(top_i, 0, None)], -1)
+        if not view.stream_entries:
             with prof.stage("format"):
                 return [self._format(top_s[i], pids[i])
                         for i in range(nreal)]
@@ -645,8 +864,8 @@ class SearchService:
         best_i = pids.astype(np.int64)
 
         def _load_tail():
-            for entry in self._stream_entries:
-                ids, vecs, scl = self.store._load_entry(entry, raw=True)
+            for entry in view.stream_entries:
+                ids, vecs, scl = view.store._load_entry(entry, raw=True)
                 yield np.asarray(ids, np.int64), np.asarray(vecs), scl
 
         with prof.stage("topk"):
@@ -654,8 +873,8 @@ class SearchService:
                 nrows = vecs.shape[0]
                 if nrows == 0:
                     continue
-                pages, scales = stage_shard(vecs, self._pad_rows,
-                                            self.store.dim,
+                pages, scales = stage_shard(vecs, view.pad_rows,
+                                            view.store.dim,
                                             self.embedder.mesh, scales=scl)
                 best_s, best_i = merge_shard_topk(
                     q, pages, ids, nrows, self.embedder.mesh, k,

@@ -100,7 +100,8 @@ def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
                         query_block: Optional[int] = None,
                         out_path: Optional[str] = None,
                         index=None,
-                        nprobe: Optional[int] = None) -> HardNegatives:
+                        nprobe: Optional[int] = None,
+                        start: int = 0) -> HardNegatives:
     """Top-`search_k` retrieval per training query minus the gold page,
     truncated to `num_negatives`. Queries are embedded with CURRENT params
     (periodic re-mining keeps negatives hard as the model improves).
@@ -131,6 +132,14 @@ def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
     for config-4 scale mining. Retrieval is approximate; mined negatives
     are "hard" by construction either way, and any lists the ANN misses
     are by definition the least-similar candidates.
+
+    Incremental re-mine (`start` > 0; docs/UPDATES.md): after a corpus
+    append, only the NEW queries [start, nq) are mined — against the
+    GROWN store, so their negatives come from every generation — and
+    spliced onto the existing table at `out_path` (required, single
+    process), which keeps the mine cost proportional to the appended
+    pages instead of the corpus. Re-mining the old rows against the new
+    pages stays a periodic full mine, exactly like before.
     """
     from dnn_page_vectors_tpu.parallel.multihost import barrier, process_info
     nq = min(num_queries or corpus.num_pages, corpus.num_pages)
@@ -144,14 +153,29 @@ def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
             "multi-process mine_hard_negatives requires out_path (the table "
             "is merged through per-writer files on the shared filesystem, "
             "like the store's multi-writer embed)")
+    prev = None
+    if start:
+        if pc > 1:
+            raise ValueError("incremental mining (start > 0) is a "
+                             "single-process job")
+        if out_path is None or not os.path.exists(out_path):
+            raise ValueError(
+                "start > 0 extends an existing mined table: pass out_path "
+                "pointing at the previous mine's output")
+        prev = np.load(out_path, mmap_mode="r")
+        if prev.shape[0] < start or prev.shape[1] != H:
+            raise ValueError(
+                f"existing table {tuple(prev.shape)} at {out_path} cannot "
+                f"seed start={start}, num_negatives={H}; run a full mine")
     per = -(-nq // pc)                     # contiguous equal slices
-    lo, hi = pi * per, min(nq, (pi + 1) * per)
+    lo, hi = (start, nq) if start else (pi * per, min(nq, (pi + 1) * per))
     qb = query_block or 8192
     if out_path is not None:
         # fill a side file, os.replace on completion: an interrupted mine
         # must never leave a complete-looking partial table at out_path (the
         # pipeline's resume check is existence-based)
-        my_path = out_path + (f".w{pi:04d}" if pc > 1 else ".tmp")
+        my_path = out_path + (f".w{pi:04d}" if pc > 1
+                              else ".part" if start else ".tmp")
         table = np.lib.format.open_memmap(
             my_path, mode="w+", dtype=np.int32, shape=(max(hi - lo, 0), H))
     else:
@@ -172,7 +196,25 @@ def mine_hard_negatives(embedder: BulkEmbedder, corpus: ToyCorpus,
     if out_path is not None:
         table.flush()
         del table
-        if pc > 1:
+        if start:
+            # splice: old rows [0, start) from the previous table, the
+            # freshly mined [start, nq) from the side file — O(block)
+            # copies, atomic replace, so an interrupted splice leaves the
+            # previous table intact
+            tmp = out_path + ".tmp"
+            out = np.lib.format.open_memmap(
+                tmp, mode="w+", dtype=np.int32, shape=(nq, H))
+            for b in range(0, start, qb):
+                out[b: min(b + qb, start)] = prev[b: min(b + qb, start)]
+            part = np.load(my_path, mmap_mode="r")
+            for b in range(0, nq - start, qb):
+                out[start + b: start + min(b + qb, nq - start)] = \
+                    part[b: min(b + qb, nq - start)]
+            out.flush()
+            del out, prev, part
+            os.replace(tmp, out_path)
+            os.remove(my_path)
+        elif pc > 1:
             barrier("mine_slices_written")
             if pi == 0:
                 tmp = out_path + ".tmp"

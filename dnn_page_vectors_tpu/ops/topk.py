@@ -283,8 +283,8 @@ def merge_shard_topk(q: jnp.ndarray, pages, page_ids: np.ndarray, valid: int,
 
 
 def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
-                    chunk: int = 8192, query_batch: int = 1024
-                    ) -> Tuple[np.ndarray, np.ndarray]:
+                    chunk: int = 8192, query_batch: int = 1024,
+                    entries=None) -> Tuple[np.ndarray, np.ndarray]:
     """Stream the vector store through `sharded_topk`, one disk shard at a
     time, merging a host-side running top-k. Returns (scores [Nq, k],
     page_ids [Nq, k] int64, -1 padded). This is the cross-shard merge path
@@ -293,18 +293,23 @@ def topk_over_store(query_vecs: np.ndarray, store, mesh: Mesh, k: int = 10,
     double-buffered (store.iter_shards(prefetch=1)): shard i+1's disk read
     runs on a background reader thread while shard i is staged and scored,
     so disk latency overlaps device top-k instead of serializing after it.
+    `entries` sweeps an explicit shard-table snapshot instead of the live
+    one (the serving hot-swap's old-view isolation, docs/UPDATES.md).
     """
     nq, dim = query_vecs.shape
     n_data = mesh.shape["data"]
     best_s = np.full((nq, k), -np.inf, np.float32)
     best_i = np.full((nq, k), -1, np.int64)
-    if store.num_vectors == 0 or nq == 0:
+    if entries is None:
+        entries = store.shards()
+    if sum(s["count"] for s in entries) == 0 or nq == 0:
         return best_s, best_i
     # one static shape for every disk shard -> a single compiled program
-    shard_rows = max((s["count"] for s in store.shards()), default=0)
+    shard_rows = max((s["count"] for s in entries), default=0)
     shard_rows += (-shard_rows) % max(n_data, 1)
     qb = min(query_batch, nq)
-    for ids, vecs, scl in store.iter_shards(raw=True, prefetch=1):
+    for ids, vecs, scl in store.iter_shards(raw=True, prefetch=1,
+                                            entries=entries):
         n = vecs.shape[0]
         if n == 0:        # empty shard: nothing to score, don't stage it
             continue

@@ -1,0 +1,19 @@
+"""Live corpus updates (docs/UPDATES.md): append-only store generations,
+incremental IVF refresh, zero-downtime serving hot-swap.
+
+The batch pipeline treats the corpus as immutable — embed once, index
+once, serve until the next full rebuild. This subsystem makes the
+store/index/serve stack mutable end to end:
+
+  * `append_corpus` embeds ONLY the new id-range (plus any updated pages)
+    into a fresh store generation, with tombstones masking the stale rows
+    (infer/vector_store.py GenerationWriter);
+  * `IVFIndex.update` (index/ivf.py) assigns only the new generation's
+    shards to the existing centroids — O(new shards), not O(corpus) —
+    until accumulated drift triggers a full k-means rebuild;
+  * `SearchService.refresh` (infer/serve.py) atomically swaps the new
+    store view + index generation under live traffic.
+"""
+from dnn_page_vectors_tpu.updates.append import append_corpus
+
+__all__ = ["append_corpus"]

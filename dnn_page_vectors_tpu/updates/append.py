@@ -1,0 +1,109 @@
+"""Append new/updated pages to an embedded store as one generation
+(docs/UPDATES.md; the write half of the live-update subsystem).
+
+`embed_corpus` sweeps the WHOLE corpus and owns the base (generation-0)
+layout; this path embeds only the delta — the id-range past the store's
+append cursor (`next_page_id`, which counts quarantined ranges so a lost
+shard's ids are never re-issued to new documents) plus any explicitly
+updated pages — and publishes it atomically through the GenerationWriter
+protocol: data files first, generation manifest last, so a crash or an
+injected fault mid-append costs exactly the uncommitted generation and
+readers keep serving the previous one.
+
+Determinism: the same corpus range, params, and store dtype produce
+byte-identical generation files (the page tower's fp16 cast and the int8
+quantization math are shared with the bulk path), so an append is as
+reproducible as the base embed — test-pinned in tests/test_updates.py.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.utils import faults
+
+
+def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
+                  start: Optional[int] = None, stop: Optional[int] = None,
+                  tombstone: Iterable[int] = (),
+                  update_ids: Iterable[int] = (),
+                  batch_size: Optional[int] = None,
+                  log=None) -> Dict:
+    """Embed corpus pages [start, stop) — default: everything past the
+    store's append cursor — plus `update_ids` (existing pages re-embedded
+    with fresh text) into a new generation; `tombstone` page ids are
+    deleted outright. Updated ids are tombstoned automatically, so their
+    old rows mask out while the new rows serve.
+
+    Returns the append stats dict (generation, appended, updated,
+    tombstoned, id range, shards, seconds). A no-op delta (nothing new,
+    nothing updated, nothing tombstoned) returns without creating a
+    generation.
+    """
+    if store.model_step is None:
+        raise ValueError(
+            "store is unstamped (no model_step); run the base 'embed' "
+            "before appending — appends must share the base params")
+    cursor = store.next_page_id()
+    start = cursor if start is None else int(start)
+    if start < cursor:
+        raise ValueError(
+            f"append start={start} overlaps ids already assigned (append "
+            f"cursor {cursor}, incl. quarantined ranges "
+            f"{store.missing_id_ranges()}); appends must never re-issue "
+            "an id — use update_ids to re-embed existing pages")
+    stop = corpus.num_pages if stop is None else min(int(stop),
+                                                     corpus.num_pages)
+    update_ids = sorted({int(i) for i in update_ids})
+    tombstone = sorted({int(i) for i in tombstone})
+    for i in update_ids + tombstone:
+        if i >= start:
+            raise ValueError(
+                f"page id {i} is not an existing page (append range starts "
+                f"at {start}); only already-assigned ids can be updated or "
+                "tombstoned")
+    new_ids = list(range(start, stop))
+    if not new_ids and not update_ids and not tombstone:
+        return {"generation": store.generation, "appended": 0, "updated": 0,
+                "tombstoned": 0, "shards": 0, "seconds": 0.0}
+    t0 = time.perf_counter()
+    # updated pages ride in the same generation AFTER the new range, so a
+    # pure append and an append+update share the new-range shard bytes
+    all_ids = np.array(new_ids + update_ids, np.int64)
+    writer = store.begin_generation(tombstones=set(tombstone) | set(update_ids))
+    shard_size = store.manifest["shard_size"]
+    bs = batch_size or embedder.cfg.eval.embed_batch_size
+    try:
+        for s in range(0, all_ids.shape[0], shard_size):
+            ids = all_ids[s: s + shard_size]
+            vecs = embedder.embed_texts(
+                [corpus.page_text(int(i)) for i in ids], tower="page",
+                batch_size=bs)
+            writer.write_shard(ids, vecs)
+        man = writer.commit()
+    except BaseException:
+        writer.abort()     # readers never see a half-written generation
+        raise
+    dt = time.perf_counter() - t0
+    stats = {
+        "generation": man["gen"],
+        "appended": len(new_ids),
+        "updated": len(update_ids),
+        "tombstoned": len(tombstone) + len(update_ids),
+        "id_start": man["id_start"],
+        "id_end": man["id_end"],
+        "shards": len(man["shards"]),
+        "seconds": round(dt, 3),
+        "append_docs_per_s": round(all_ids.shape[0] / max(dt, 1e-9), 2),
+    }
+    if log is not None:
+        rec = {"append_generation": man["gen"], **stats}
+        fc = faults.counters()
+        if fc:
+            rec["fault_counters"] = fc
+        log.write(rec)
+    return stats

@@ -23,14 +23,25 @@ survives actual failures. This module supplies both halves of the proof:
     bench record so recovery-path activity is observable, not silent.
 
 Injection points (op names):
-  shard_write    write_shard data-file write (check; inside retry)
+  shard_write    write_shard data-file write (check; inside retry) — both
+                 the base layout and generation appends go through it
   shard_file     the shard .vec.npy after fsync (corrupt)
   manifest_dump  atomic manifest dump (check; inside retry)
   manifest_file  the manifest tmp file before its rename (corrupt)
+  gen_manifest_dump  generation manifest dump (check; inside retry)
+  gen_manifest_file  the generation manifest tmp before rename (corrupt) —
+                 a torn generation manifest quarantines THAT generation
+                 and readers keep the chain before it (docs/UPDATES.md)
   shard_read     store shard load (check)
   ckpt_save      CheckpointManager.save (check; inside retry)
   ckpt_file      the newest checkpoint step dir after save (corrupt_dir)
   hbm_stage      per-shard HBM staging in SearchService (check)
+  index_write    IVF index build/update file write (check; inside retry) —
+                 scheduling it during IVFIndex.update is the
+                 posting-append fault: the index manifest stays untouched
+                 and serving falls back to exact, visibly
+  index_file     an IVF index file after fsync (corrupt)
+  index_read     IVF posting load on open (check)
 
 Plan syntax (config `faults.plan` / CLI `--faults`):
   "op:kind:at[:count]" joined by commas; `at` is the 0-based index of the
