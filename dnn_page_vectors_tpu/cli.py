@@ -7,6 +7,7 @@
   python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --query "..."
   python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --queries q.txt
   python -m dnn_page_vectors_tpu.cli index --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli index --config cdssm_toy --pq
   python -m dnn_page_vectors_tpu.cli search --config cdssm_toy --nprobe 8 ...
   python -m dnn_page_vectors_tpu.cli pipeline --config hardneg_v5p64 --rounds 4
   python -m dnn_page_vectors_tpu.cli append --config cdssm_toy \
@@ -129,6 +130,12 @@ def main(argv=None) -> None:
                     help="search/eval/mine: IVF lists probed per query — "
                          "implies serve.index=ivf (docs/ANN.md; shorthand "
                          "for --set serve.index=ivf --set serve.nprobe=N)")
+    ap.add_argument("--pq", action="store_true",
+                    help="index: train OPQ+PQ compressed posting payloads "
+                         "alongside the inverted file (docs/ANN.md) — "
+                         "serve.pq_m subspaces, or an automatic ~dim/8 "
+                         "when the knob is 0; search then runs on-device "
+                         "ADC over m-byte codes with an exact re-rank")
     ap.add_argument("--rounds", type=int, default=2,
                     help="pipeline: train->embed->mine->train rounds")
     ap.add_argument("--config", default="cdssm_toy", choices=sorted(CONFIGS))
@@ -217,23 +224,42 @@ def main(argv=None) -> None:
         import time as _time
 
         from dnn_page_vectors_tpu.index.ivf import IVFIndex
+        from dnn_page_vectors_tpu.index.pq import auto_pq_m
         from dnn_page_vectors_tpu.parallel.multihost import local_mesh
         store = VectorStore(store_dir)
+        # --pq (or a non-zero serve.pq_m knob) turns on compressed
+        # posting payloads; the flag alone picks an automatic ~dim/8
+        # subspace count for the store's geometry
+        pq_m = cfg.serve.pq_m
+        if args.pq and not pq_m:
+            pq_m = auto_pq_m(store.dim)
         t0 = _time.perf_counter()
         idx = IVFIndex.build(store, local_mesh(cfg.mesh),
                              nlist=cfg.serve.nlist,
                              iters=cfg.serve.kmeans_iters,
                              seed=cfg.data.seed,
-                             init=cfg.serve.kmeans_init)
+                             init=cfg.serve.kmeans_init,
+                             balance=cfg.serve.kmeans_balance,
+                             pq_m=pq_m, pq_iters=cfg.serve.pq_iters,
+                             opq_iters=cfg.serve.pq_opq_iters)
         # init->final imbalance delta: what the seeding bought (k-means++
         # vs the random draw it replaced; docs/ANN.md)
         init_imb = float(idx.manifest.get("init_imbalance", 0.0))
+        # raw->balanced delta: what the assignment cap bought (the
+        # balanced-init ROADMAP item; 0 when serve.kmeans_balance is off)
+        raw_imb = float(idx.manifest.get("imbalance_raw", idx.imbalance))
+        pq_sec = idx.manifest.get("pq") or {}
         print(json.dumps({
             "store": store_dir, "vectors": store.num_vectors,
             "nlist": idx.nlist, "imbalance": idx.imbalance,
             "kmeans_init": idx.manifest.get("init"),
             "imbalance_init": init_imb,
             "imbalance_delta": round(init_imb - idx.imbalance, 4),
+            "balance_cap": idx.manifest.get("balance_cap", 0),
+            "imbalance_raw": raw_imb,
+            "imbalance_balance_delta": round(raw_imb - idx.imbalance, 4),
+            "pq_m": idx.pq_m,
+            "codebook_build_seconds": pq_sec.get("train_seconds"),
             "model_step": idx.model_step,
             "build_seconds": round(_time.perf_counter() - t0, 3),
             "fault_counters": faults.counters()}, sort_keys=True))

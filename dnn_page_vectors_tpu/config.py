@@ -186,6 +186,34 @@ class ServeConfig:
     # imbalance delta) or "random" (uniform pool draw). Both seeded and
     # byte-deterministic.
     kmeans_init: str = "kmeans++"
+    # Balanced final assignment (docs/ANN.md): >0 caps every list at
+    # ceil(factor * N / nlist) rows during the build's assignment sweep —
+    # overflow rows spill to their next-best centroid (soft cap), cutting
+    # hot-list imbalance at a small recall cost. 0 disables (pure argmax,
+    # the pre-balance behavior); `cli index` reports the raw->balanced
+    # imbalance delta.
+    kmeans_balance: float = 0.0
+    # OPQ+PQ compressed posting payloads (index/pq.py, docs/ANN.md):
+    # number of PQ subspaces (must divide model.out_dim). 0 = plain IVF
+    # (stored-width posting gather, the pre-PQ behavior); `cli index --pq`
+    # picks an automatic m (~out_dim/8) when this is 0. With PQ on, the
+    # candidate gather moves m bytes/row instead of the stored row width
+    # and scoring runs as on-device ADC with an exact re-rank on top.
+    pq_m: int = 0
+    # Per-subspace codebook k-means iterations (PQ builds).
+    pq_iters: int = 8
+    # OPQ rotation/codebook alternations (Ge et al. 2013). 0 = plain PQ
+    # (identity rotation).
+    pq_opq_iters: int = 3
+    # ADC candidates exact-reranked per query from the store (the final
+    # top-k always comes from stored-width rows, preserving the
+    # recall-vs-exact contract). 0 = auto max(8k, 64).
+    pq_rerank: int = 0
+    # HBM budget for the resident hot posting set (PQ indexes only): the
+    # largest lists' codes + probed-list metadata stage to device at view
+    # build so their per-request host gather disappears; the non-resident
+    # tail falls back to the mmap path. 0 disables.
+    hot_postings_gb: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -202,6 +230,14 @@ class UpdatesConfig:
     # date automatically when one exists. False = store-only refresh
     # (the index goes stale and serving falls back to exact, visibly).
     auto_update_index: bool = True
+    # Tombstone-aware HBM restage policy (docs/UPDATES.md): a refresh()
+    # REUSES a staged device shard whose only change is new tombstones as
+    # long as the staged block's dead-row fraction stays <= this threshold
+    # (the dead rows are masked in the id table instead — they can occupy
+    # but never win a result slot), and restages it once density crosses
+    # the threshold. metrics() reports restage_skipped/restage_forced.
+    # 0.0 restores the exact-ids policy (any tombstone restages).
+    restage_tombstone_density: float = 0.05
 
 
 @dataclasses.dataclass(frozen=True)

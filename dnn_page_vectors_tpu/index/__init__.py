@@ -1,11 +1,16 @@
 """IVF ANN index subsystem (docs/ANN.md).
 
 `kmeans.py` trains the coarse quantizer (nlist centroids) on the MXU by
-streaming vector-store shards through the mesh; `ivf.py` persists the
-inverted file next to the store and serves sublinear `search(q, k, nprobe)`
-with an exact on-device re-rank. Every retrieval caller (serve, eval, mine)
-falls back to the exact brute-force path (`ops/topk.py`) when the index is
-missing, stale, or quarantined.
+streaming vector-store shards through the mesh — and the grouped
+per-subspace Euclidean variant that trains PQ codebooks; `pq.py` is the
+OPQ+PQ codec (rotation + codebooks, device encode/LUT/ADC kernels);
+`ivf.py` persists the inverted file next to the store and serves
+sublinear `search(q, k, nprobe)` — stored-width gather + exact re-rank,
+or, on PQ builds, m-byte code gather + on-device ADC with the exact
+re-rank kept for the final top-k. Every retrieval caller (serve, eval,
+mine) falls back to the exact brute-force path (`ops/topk.py`) when the
+index is missing, stale, or quarantined.
 """
 from dnn_page_vectors_tpu.index.ivf import IndexUnavailable, IVFIndex  # noqa: F401
 from dnn_page_vectors_tpu.index.kmeans import train_kmeans  # noqa: F401
+from dnn_page_vectors_tpu.index.pq import PQCodec, auto_pq_m, train_pq  # noqa: F401

@@ -15,11 +15,30 @@ bench `ann_recall_at_10`), not a hope.
 Layout (next to the store, same manifest machinery as VectorStore):
 
   <store>/ivf/manifest.json     nlist, dim, model_step stamp, seed, per-file
-                                byte sizes + CRC32s, per-shard posting table
+                                byte sizes + CRC32s, per-shard posting table,
+                                optional "pq" section (m, ksub, opq config)
   <store>/ivf/centroids.npy     [nlist, D] float32 unit-norm centroids
   <store>/ivf/posting_NNNNN.ord.npy   [count] int32 shard-row order, grouped
                                       by centroid (CSR values)
   <store>/ivf/posting_NNNNN.off.npy   [nlist+1] int64 CSR offsets
+  <store>/ivf/pq_rotation.npy   [D, D] f32 OPQ rotation       (PQ builds)
+  <store>/ivf/pq_codebooks.npy  [m, ksub, dsub] f32 codebooks (PQ builds)
+  <store>/ivf/posting_NNNNN.pqc.npy   [count, m] uint8 PQ codes, SHARD ROW
+                                      order (gathered through .ord like the
+                                      store rows themselves)
+
+Compressed payloads (index/pq.py, docs/ANN.md): a PQ build additionally
+trains an OPQ rotation + per-subspace codebooks on the same streamed,
+seeded k-means machinery and stores m-byte codes per row. `search` then
+runs ADC — per-query lookup tables computed on device, candidates scored
+from m-byte codes instead of stored-width rows, a running on-device top-r
+per query — and keeps the EXACT re-rank from the store for the final
+top-k (only the ~r surviving rows per query are gathered at stored
+width), so the recall contract is measured on true scores while the
+candidate gather moves ~m bytes/row. `stage_hot` pins the largest lists'
+codes (plus their list/id metadata) in device memory so resident lists
+skip the per-request host gather entirely; the non-resident tail still
+reads the mmap (infer/serve.py wires the budget).
 
 Validity contract (docs/ROBUSTNESS.md semantics): `open()` re-checks the
 recorded model step against the store's stamp, the recorded shard table
@@ -51,8 +70,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from dnn_page_vectors_tpu.index.kmeans import assign_store, train_kmeans
+from dnn_page_vectors_tpu.index.pq import PQCodec, adc_topr, train_pq
 from dnn_page_vectors_tpu.infer.vector_store import crc_file
-from dnn_page_vectors_tpu.ops.topk import chunked_topk, rerank_candidates
+from dnn_page_vectors_tpu.ops.topk import (
+    chunked_topk, rerank_candidates, rerank_positions)
 from dnn_page_vectors_tpu.utils import faults
 
 DIRNAME = "ivf"
@@ -122,14 +143,19 @@ def _atomic_dump(obj, path: str) -> None:
 
 class IVFIndex:
     def __init__(self, store, manifest: Dict, centroids: np.ndarray,
-                 postings: Dict[int, Tuple[np.ndarray, np.ndarray]]):
+                 postings: Dict[int, Tuple[np.ndarray, np.ndarray]],
+                 pq: Optional[PQCodec] = None):
         self.store = store
         self.manifest = manifest
         self.centroids = centroids                 # [nlist, D] f32
         self._postings = postings                  # {shard: (order, offsets)}
         self._entries = {s["index"]: s for s in store.shards()}
+        self._meta = {s["index"]: s for s in manifest["shards"]}
         self._raw: Dict[int, tuple] = {}           # lazy mmap cache
+        self._codes: Dict[int, np.ndarray] = {}    # lazy PQ code mmaps
         self._dev_centroids = None
+        self.pq = pq                               # OPQ+PQ codec or None
+        self._hot = None                           # stage_hot device state
         # total rows per list across shards: candidate accounting without
         # touching the postings at search time
         sizes = np.zeros((self.nlist,), np.int64)
@@ -137,7 +163,8 @@ class IVFIndex:
             sizes += np.diff(offsets)
         self.list_sizes = sizes
         self.stats = {"searches": 0, "lists_scanned": 0,
-                      "candidates_reranked": 0}
+                      "candidates_reranked": 0, "gather_bytes": 0,
+                      "reranked_rows": 0, "hot_rows_scored": 0}
 
     # -- identity ----------------------------------------------------------
     @property
@@ -158,26 +185,86 @@ class IVFIndex:
         (0 = freshly built; docs/UPDATES.md)."""
         return int(self.manifest.get("index_generation", 0))
 
+    @property
+    def pq_m(self) -> int:
+        """PQ subspace count — bytes per posting code row (0 =
+        uncompressed stored-width postings)."""
+        return int((self.manifest.get("pq") or {}).get("m", 0))
+
+    @property
+    def hot_rows(self) -> int:
+        """Rows resident in the staged hot posting set (0 = not staged)."""
+        return 0 if self._hot is None else int(self._hot["rows"])
+
     # -- build -------------------------------------------------------------
     @staticmethod
-    def _assign_postings(d: str, store, mesh, centroids: np.ndarray,
-                         entries, chunk: int):
+    def _balance_assignments(tops: np.ndarray, nlist: int, cap: int
+                             ) -> np.ndarray:
+        """Deterministic capacity-capped assignment over the FULL row set
+        (docs/ANN.md, the balanced-init ROADMAP item): every row starts on
+        its best centroid; a list holding more than `cap` rows keeps its
+        first `cap` (stable global row order) and spills the rest to each
+        row's next-ranked choice, for choices-1 rounds. Rows that exhaust
+        their choices stay where they are (soft cap) — recall never
+        depends on the cap, only which list a row waits in. `tops` is
+        [N, C] ranked centroid choices; returns the final [N] assignment."""
+        n, n_choices = tops.shape
+        cur = tops[:, 0].copy()
+        level = np.zeros((n,), np.int64)
+        for _ in range(max(1, n_choices - 1)):
+            order = np.argsort(cur, kind="stable")      # group rows by list
+            grouped = cur[order]
+            starts = np.searchsorted(grouped, np.arange(nlist))
+            rank = np.arange(n) - starts[grouped]
+            overflow = order[rank >= cap]
+            movable = overflow[level[overflow] < n_choices - 1]
+            if movable.size == 0:
+                break
+            level[movable] += 1
+            cur[movable] = tops[movable, level[movable]]
+        return cur
+
+    @classmethod
+    def _assign_postings(cls, d: str, store, mesh, centroids: np.ndarray,
+                         entries, chunk: int, balance_cap: int = 0,
+                         choices: int = 4):
         """Assign `entries`' rows to `centroids` and persist their CSR
-        posting files. Returns (shards_meta, postings, sizes [nlist]) for
-        exactly those entries — build runs it over the whole store,
-        update() over only the new generation's shards."""
+        posting files. Returns (shards_meta, postings, sizes [nlist],
+        sizes_raw [nlist]) for exactly those entries — build runs it over
+        the whole store, update() over only the new generation's shards.
+        With `balance_cap` > 0 the sweep takes each row's top-`choices`
+        centroids, rebalances globally (memory O(N * choices) host — the
+        opt-in price of the cap), and sizes_raw reports the pre-balance
+        first-choice counts so the imbalance delta is measurable."""
         nlist = centroids.shape[0]
         shards_meta = []
         postings: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         sizes = np.zeros((nlist,), np.int64)
+        sizes_raw = np.zeros((nlist,), np.int64)
         nonzero = [e for e in entries if e["count"] > 0]
-        for entry, assign in assign_store(store, mesh, centroids,
-                                          chunk=chunk, entries=nonzero):
+        per_shard = assign_store(
+            store, mesh, centroids, chunk=chunk, entries=nonzero,
+            choices=choices if balance_cap > 0 else 1)
+        if balance_cap > 0:
+            collected = list(per_shard)
+            tops = (np.concatenate([a for _, a in collected])
+                    if collected else np.zeros((0, choices), np.int32))
+            sizes_raw += np.bincount(tops[:, 0], minlength=nlist) \
+                if tops.size else 0
+            flat = cls._balance_assignments(tops, nlist, balance_cap)
+            out, lo = [], 0
+            for entry, a in collected:
+                out.append((entry, flat[lo: lo + a.shape[0]]))
+                lo += a.shape[0]
+            per_shard = out
+        for entry, assign in per_shard:
             order = np.argsort(assign, kind="stable").astype(np.int32)
             counts = np.bincount(assign, minlength=nlist)
             offsets = np.zeros((nlist + 1,), np.int64)
             offsets[1:] = np.cumsum(counts)
             sizes += counts
+            if balance_cap <= 0:
+                sizes_raw += counts
             stem = f"posting_{entry['index']:05d}"
             ob, oc = _write_npy(os.path.join(d, stem + ".ord.npy"), order)
             fb, fc = _write_npy(os.path.join(d, stem + ".off.npy"), offsets)
@@ -192,17 +279,42 @@ class IVFIndex:
         for entry in entries:
             if entry["count"] == 0:
                 shards_meta.append({"index": entry["index"], "count": 0})
-        return shards_meta, postings, sizes
+        return shards_meta, postings, sizes, sizes_raw
+
+    @staticmethod
+    def _encode_codes(d: str, store, codec: PQCodec, shards_meta) -> None:
+        """Encode each recorded shard's rows into its PQ code file
+        (posting_NNNNN.pqc.npy, shard ROW order — gathered through the
+        same .ord indices as the store rows) and extend the shard meta
+        in place with the pqc byte/CRC record. Streams one shard at a
+        time; update() calls this with only the new shards' meta."""
+        entries = {s["index"]: s for s in store.shards()}
+        for meta in shards_meta:
+            if meta["count"] == 0 or "ord" not in meta:
+                continue
+            _, vecs = store._load_entry(entries[meta["index"]])
+            codes = codec.encode(np.asarray(vecs, np.float32))
+            name = f"posting_{meta['index']:05d}.pqc.npy"
+            cb, cc = _write_npy(os.path.join(d, name), codes)
+            meta["pqc"] = name
+            meta["bytes"]["pqc"] = cb
+            meta["crc"]["pqc"] = cc
 
     @classmethod
     def build(cls, store, mesh, nlist: int = 0, iters: int = 8,
               seed: int = 0, chunk: int = 8192,
               sample_per_shard: Optional[int] = None,
-              init: str = "kmeans++") -> "IVFIndex":
+              init: str = "kmeans++", balance: float = 0.0,
+              pq_m: int = 0, pq_iters: int = 8,
+              opq_iters: int = 3) -> "IVFIndex":
         """Train the quantizer, assign every store row, and persist the
         inverted file next to the store (atomic manifest last, so a crash
         mid-build leaves the previous index or none — never a torn one
-        that passes verification)."""
+        that passes verification). `balance` > 0 caps lists at
+        ceil(balance * N / nlist) rows during the assignment sweep
+        (overflow spills to the row's next-best centroid — docs/ANN.md).
+        `pq_m` > 0 additionally trains the OPQ+PQ codec (index/pq.py) and
+        persists m-byte codes per row for the ADC search path."""
         t0 = time.perf_counter()
         N = store.num_vectors
         if N == 0:
@@ -212,14 +324,39 @@ class IVFIndex:
         centroids, kstats = train_kmeans(
             store, mesh, nlist, iters=iters, seed=seed, chunk=chunk,
             sample_per_shard=sample_per_shard, init=init)
+        cap = (int(math.ceil(float(balance) * N / nlist))
+               if balance and balance > 0 else 0)
+        codec = None
+        pq_stats: Optional[Dict] = None
+        if pq_m:
+            codec, pq_stats = train_pq(store, int(pq_m), iters=pq_iters,
+                                       opq_iters=opq_iters, seed=seed)
         d = index_dir(store)
         os.makedirs(d, exist_ok=True)
         cb, cc = _write_npy(os.path.join(d, "centroids.npy"), centroids)
-        shards_meta, postings, sizes = cls._assign_postings(
-            d, store, mesh, centroids, store.shards(), chunk)
+        shards_meta, postings, sizes, sizes_raw = cls._assign_postings(
+            d, store, mesh, centroids, store.shards(), chunk,
+            balance_cap=cap)
+        pq_section = None
+        if codec is not None:
+            rb, rc = _write_npy(os.path.join(d, "pq_rotation.npy"),
+                                codec.rotation)
+            kb, kc = _write_npy(os.path.join(d, "pq_codebooks.npy"),
+                                codec.codebooks)
+            cls._encode_codes(d, store, codec, shards_meta)
+            pq_section = {
+                **pq_stats,
+                "rotation": {"file": "pq_rotation.npy",
+                             "bytes": rb, "crc": rc},
+                "codebooks": {"file": "pq_codebooks.npy",
+                              "bytes": kb, "crc": kc},
+            }
         shards_meta.sort(key=lambda s: s["index"])
         imbalance = float(nlist * np.square(sizes, dtype=np.float64).sum()
                           / max(N, 1) ** 2)
+        imbalance_raw = float(
+            nlist * np.square(sizes_raw, dtype=np.float64).sum()
+            / max(N, 1) ** 2)
         manifest = {
             "version": 1, "nlist": nlist, "dim": store.dim,
             "dtype": store.manifest["dtype"],
@@ -228,6 +365,11 @@ class IVFIndex:
             "init": kstats["init"],
             "init_imbalance": kstats["init_imbalance"],
             "num_vectors": int(N), "imbalance": round(imbalance, 4),
+            # balanced-assignment record (docs/ANN.md): the cap applied in
+            # the final sweep and the first-choice imbalance it improved
+            # on (balance_cap 0 = pure argmax; imbalance_raw == imbalance)
+            "balance": float(balance), "balance_cap": cap,
+            "imbalance_raw": round(imbalance_raw, 4),
             # live-update bookkeeping (docs/UPDATES.md): rows covered by
             # the last full k-means vs rows appended incrementally since —
             # their ratio is the drift that triggers the next full rebuild
@@ -238,8 +380,10 @@ class IVFIndex:
             "centroids": {"file": "centroids.npy", "bytes": cb, "crc": cc},
             "shards": shards_meta,
         }
+        if pq_section is not None:
+            manifest["pq"] = pq_section
         _atomic_dump(manifest, os.path.join(d, MANIFEST))
-        return cls(store, manifest, centroids, postings)
+        return cls(store, manifest, centroids, postings, pq=codec)
 
     # -- incremental update (docs/UPDATES.md) ------------------------------
     @classmethod
@@ -264,15 +408,29 @@ class IVFIndex:
         (SearchService.refresh, cli refresh, bench) can count
         incremental_updates vs full_rebuilds. Raises (IOError etc.) only
         when the write path itself fails — the manifest is untouched then,
-        so readers keep the previous index generation."""
+        so readers keep the previous index generation.
+
+        PQ config is INHERITED: an index built with compressed payloads
+        keeps them — incremental updates encode the new shards' codes
+        with the existing rotation/codebooks (O(new shards), same as the
+        posting append), and a drift rebuild retrains the codec with the
+        recorded m/iters/opq settings. The balance factor is inherited
+        the same way, though incremental appends assign new rows by
+        plain argmax — the cap re-applies at the next full rebuild."""
         t0 = time.perf_counter()
         d = index_dir(store)
         mpath = os.path.join(d, MANIFEST)
 
-        def _rebuild(reason: str) -> Tuple["IVFIndex", Dict]:
+        def _rebuild(reason: str, man: Optional[Dict] = None
+                     ) -> Tuple["IVFIndex", Dict]:
+            pq_cfg = (man or {}).get("pq") or {}
             idx = cls.build(store, mesh, nlist=nlist, iters=iters,
                             seed=0 if seed is None else seed, chunk=chunk,
-                            init=init)
+                            init=init,
+                            balance=(man or {}).get("balance", 0.0),
+                            pq_m=pq_cfg.get("m", 0),
+                            pq_iters=pq_cfg.get("iters", 8),
+                            opq_iters=pq_cfg.get("opq_iters", 3))
             faults.count("index_full_rebuilds")
             return idx, {"action": "rebuild", "reason": reason,
                          "seconds": round(time.perf_counter() - t0, 3)}
@@ -286,14 +444,15 @@ class IVFIndex:
             return _rebuild("torn index manifest")
         if (man.get("model_step") != store.model_step
                 or man.get("dim") != store.dim):
-            return _rebuild("model step / dim changed")
+            return _rebuild("model step / dim changed", man)
         live = store.shards()
         live_by_idx = {s["index"]: s["count"] for s in live}
         recorded = {s["index"]: s["count"] for s in man.get("shards", [])}
         if any(recorded.get(i) != c for i, c in live_by_idx.items()
                if i in recorded) or any(i not in live_by_idx
                                         for i in recorded):
-            return _rebuild("recorded shards changed (quarantine/re-embed)")
+            return _rebuild("recorded shards changed (quarantine/re-embed)",
+                            man)
         new_entries = [e for e in live if e["index"] not in recorded]
         if not new_entries:
             return (cls.open(store),
@@ -302,18 +461,25 @@ class IVFIndex:
         try:
             cls._verify_files(d, man)      # don't extend corrupt postings
         except IndexUnavailable as e:
-            return _rebuild(f"existing index unhealthy ({e})")
+            return _rebuild(f"existing index unhealthy ({e})", man)
         total = store.num_vectors
         appended = (int(man.get("appended_since_build", 0))
                     + sum(e["count"] for e in new_entries))
         drift = appended / max(total, 1)
         if drift > rebuild_drift:
             return _rebuild(
-                f"drift {drift:.3f} > rebuild_drift {rebuild_drift}")
+                f"drift {drift:.3f} > rebuild_drift {rebuild_drift}", man)
         centroids = np.asarray(
             np.load(os.path.join(d, man["centroids"]["file"])), np.float32)
-        new_meta, _, new_sizes = cls._assign_postings(
+        new_meta, _, new_sizes, _ = cls._assign_postings(
             d, store, mesh, centroids, new_entries, chunk)
+        if man.get("pq"):
+            # incremental CODE append: new shards encode with the existing
+            # rotation/codebooks — O(new shards), like the posting append
+            codec = PQCodec(
+                np.load(os.path.join(d, man["pq"]["rotation"]["file"])),
+                np.load(os.path.join(d, man["pq"]["codebooks"]["file"])))
+            cls._encode_codes(d, store, codec, new_meta)
         man["shards"] = sorted(man["shards"] + new_meta,
                                key=lambda s: s["index"])
         man["num_vectors"] = int(total)
@@ -389,16 +555,26 @@ class IVFIndex:
             postings[s["index"]] = (
                 np.load(os.path.join(d, s["ord"])),
                 np.load(os.path.join(d, s["off"])))
-        return cls(store, man, np.asarray(centroids, np.float32), postings)
+        codec = None
+        if man.get("pq"):
+            codec = PQCodec(
+                np.load(os.path.join(d, man["pq"]["rotation"]["file"])),
+                np.load(os.path.join(d, man["pq"]["codebooks"]["file"])))
+        return cls(store, man, np.asarray(centroids, np.float32), postings,
+                   pq=codec)
 
     @staticmethod
     def _verify_files(d: str, man: Dict) -> None:
         files = [(man["centroids"]["file"], man["centroids"]["bytes"],
                   man["centroids"]["crc"])]
+        for key in ("rotation", "codebooks"):
+            rec = man.get("pq", {}).get(key)
+            if rec is not None:
+                files.append((rec["file"], rec["bytes"], rec["crc"]))
         for s in man["shards"]:
             if s["count"] == 0:
                 continue
-            for key in ("ord", "off"):
+            for key in ("ord", "off") + (("pqc",) if "pqc" in s else ()):
                 files.append((s[key], s["bytes"][key], s["crc"][key]))
         for name, want_bytes, want_crc in files:
             path = os.path.join(d, name)
@@ -427,6 +603,105 @@ class IVFIndex:
             raw = self._raw[sidx] = self.store._load_entry(
                 self._entries[sidx], raw=True)
         return raw
+
+    def _codes_raw(self, sidx: int) -> np.ndarray:
+        arr = self._codes.get(sidx)
+        if arr is None:
+            arr = self._codes[sidx] = np.load(
+                os.path.join(index_dir(self.store),
+                             self._meta[sidx]["pqc"]), mmap_mode="r")
+        return arr
+
+    def _gather_codes(self, cents: np.ndarray):
+        """Candidate block for one probed-list union at CODE width: m
+        bytes per row off the mmap'd pqc files instead of the stored row
+        width. Returns (codes [C, m] u8, page_ids [C] i64, cand_cent [C]
+        i32, src_shard [C] i32, src_row [C] i32) — the source coordinates
+        let the exact re-rank fetch only the ADC survivors' rows later.
+        Tombstoned rows get centroid -2 (matches no probed list), the
+        same dead-slot convention as _gather."""
+        c_parts, i_parts, n_parts, sh_parts, rw_parts = [], [], [], [], []
+        for sidx in sorted(self._postings):
+            order, offsets = self._postings[sidx]
+            rows = [order[offsets[c]: offsets[c + 1]] for c in cents]
+            lens = np.array([r.shape[0] for r in rows], np.int64)
+            if lens.sum() == 0:
+                continue
+            take = np.concatenate(rows)
+            ids, _, _ = self._shard_raw(sidx)
+            taken_ids = np.asarray(ids[take], np.int64)
+            c_parts.append(np.asarray(self._codes_raw(sidx)[take]))
+            i_parts.append(taken_ids)
+            cent = np.repeat(np.asarray(cents, np.int32), lens)
+            n_parts.append(np.where(taken_ids >= 0, cent, np.int32(-2)))
+            sh_parts.append(np.full((take.shape[0],), sidx, np.int32))
+            rw_parts.append(take.astype(np.int32))
+        if not c_parts:
+            return (np.zeros((0, self.pq.m), np.uint8),
+                    np.zeros((0,), np.int64), np.zeros((0,), np.int32),
+                    np.zeros((0,), np.int32), np.zeros((0,), np.int32))
+        return tuple(np.concatenate(p) for p in
+                     (c_parts, i_parts, n_parts, sh_parts, rw_parts))
+
+    def _fetch_rows(self, src_shard: np.ndarray, src_row: np.ndarray):
+        """Stored-width rows (+ int8 scales) for an explicit (shard, row)
+        set — the exact re-rank's gather: only the per-query ADC
+        survivors pay row-width bytes off the store mmaps."""
+        U = src_shard.shape[0]
+        rows = None
+        scales = None
+        for sidx in np.unique(src_shard):
+            _, vecs, scl = self._shard_raw(int(sidx))
+            mask = src_shard == sidx
+            part = np.asarray(vecs[src_row[mask]])
+            if rows is None:
+                rows = np.zeros((U, part.shape[1]), part.dtype)
+            rows[mask] = part
+            if scl is not None:
+                if scales is None:
+                    scales = np.zeros((U,), np.float16)
+                scales[mask] = np.asarray(scl[src_row[mask]])
+        return rows, scales
+
+    # -- HBM-resident hot posting set (docs/ANN.md, infer/serve.py) --------
+    def stage_hot(self, budget_bytes: float) -> Dict:
+        """Pin the largest posting lists' PQ codes — plus the per-row list
+        ids the ADC mask needs and the page-id / source tables the re-rank
+        needs — in device memory, biggest lists first until `budget_bytes`
+        runs out. Resident lists then score against the staged codes with
+        ZERO per-request host gather; non-resident lists keep the mmap
+        path, and results are identical either way (test-pinned,
+        tests/test_pq.py). Tombstones are masked at staging time (dead
+        rows get centroid -2), so restaging follows the same refresh
+        cadence as the serving HBM shards."""
+        if self.pq is None:
+            raise ValueError("stage_hot needs a PQ index (build with pq_m)")
+        per_row = self.pq.m + 4                 # code bytes + centroid id
+        resident = np.zeros((self.nlist,), bool)
+        used = 0
+        for c in np.argsort(-self.list_sizes, kind="stable"):
+            need = int(self.list_sizes[c]) * per_row
+            if self.list_sizes[c] == 0 or used + need > budget_bytes:
+                continue                        # smaller lists may still fit
+            resident[int(c)] = True
+            used += need
+        cents = np.nonzero(resident)[0]
+        codes, ids, cent, sh, rw = self._gather_codes(cents)
+        n = codes.shape[0]
+        if n == 0:
+            self._hot = None
+            return {"hot_lists": 0, "hot_rows": 0, "hot_bytes": 0}
+        pad = _bucket(n, lo=512)
+        if pad > n:
+            codes = np.concatenate(
+                [codes, np.zeros((pad - n, self.pq.m), np.uint8)])
+            cent = np.concatenate([cent, np.full((pad - n,), -1, np.int32)])
+        self._hot = {
+            "lists": resident, "rows": n, "bytes": used,
+            "codes": jnp.asarray(codes), "cent": jnp.asarray(cent),
+            "chunk": min(2048, pad), "ids": ids, "shard": sh, "row": rw}
+        return {"hot_lists": int(resident.sum()), "hot_rows": n,
+                "hot_bytes": used}
 
     def _gather(self, cents: np.ndarray):
         """Candidate block for one probed-list union: rows of every listed
@@ -461,7 +736,7 @@ class IVFIndex:
                 np.concatenate(i_parts), np.concatenate(c_parts))
 
     def search(self, qvecs: np.ndarray, k: int, nprobe: Optional[int] = None,
-               block: int = 256
+               block: int = 256, rerank: Optional[int] = None
                ) -> Tuple[np.ndarray, np.ndarray, Dict[str, int]]:
         """ANN top-k: (scores [Nq, k] f32, page_ids [Nq, k] i64 -1-padded,
         stats). Centroid scoring runs on device through `chunked_topk`
@@ -469,7 +744,14 @@ class IVFIndex:
         octave); queries are then processed in `block`-sized sub-blocks —
         per sub-block ONE gathered candidate matmul via
         `rerank_candidates`, dispatched async so sub-block i+1's host
-        gather overlaps sub-block i's device re-rank."""
+        gather overlaps sub-block i's device re-rank.
+
+        On a PQ index (manifest "pq" section) the sub-blocks route
+        through the ADC path instead (_search_adc): candidates score from
+        m-byte codes, and only each query's top-`rerank` ADC survivors
+        (default max(8k, 64)) are gathered at stored width for the exact
+        final top-k. stats["gather_bytes"] reports the store payload
+        bytes either path actually moved."""
         qvecs = np.asarray(qvecs, np.float32)
         nq = qvecs.shape[0]
         k = int(k)
@@ -489,7 +771,11 @@ class IVFIndex:
         sel = np.asarray(sel, np.int32)[:nq]
         stats = {"searches": nq, "lists_scanned": nq * nprobe,
                  "candidates_reranked":
-                     int(self.list_sizes[sel].sum())}
+                     int(self.list_sizes[sel].sum()),
+                 "gather_bytes": 0}
+        if self.pq is not None:
+            return self._search_adc(qvecs, sel, k, block, rerank,
+                                    out_s, out_i, stats)
         pending = []
         for s in range(0, nq, block):
             e = min(s + block, nq)
@@ -497,6 +783,7 @@ class IVFIndex:
             cents = np.unique(sel_b)
             cand, scl, cids, ccent = self._gather(cents)
             C = cand.shape[0]
+            stats["gather_bytes"] += C * self.store.row_bytes
             if C == 0:
                 pending.append((s, e, None, None))
                 continue
@@ -535,4 +822,130 @@ class IVFIndex:
             out_s[s:e, :kk] = np.where(pos >= 0, top_s, -np.inf)
         for key, val in stats.items():
             self.stats[key] = self.stats.get(key, 0) + val
+        return out_s, out_i, stats
+
+    def _search_adc(self, qvecs: np.ndarray, sel: np.ndarray, k: int,
+                    block: int, rerank: Optional[int],
+                    out_s: np.ndarray, out_i: np.ndarray, stats: Dict
+                    ) -> Tuple[np.ndarray, np.ndarray, Dict[str, int]]:
+        """The compressed-payload block loop (docs/ANN.md): per sub-block,
+        gather the probed lists' m-byte CODES (mmap — resident lists skip
+        the gather entirely and score against the staged device codes),
+        compute per-query ADC lookup tables on device (`pq.lut`), run the
+        running top-r over code scores (`adc_topr`, masked per query to
+        its probed lists), then fetch ONLY the union of per-query
+        survivors' rows at stored width and exact re-rank them
+        (`rerank_positions`) for the final top-k. ADC ties and the
+        survivor cut are deterministic (stable sorts, lax.top_k)."""
+        nq = qvecs.shape[0]
+        nprobe = sel.shape[1]
+        r = max(int(rerank) if rerank else max(8 * k, 64), k)
+        hot = self._hot
+        m = self.pq.m
+        for s in range(0, nq, block):
+            e = min(s + block, nq)
+            sel_b = sel[s:e]
+            cents = np.unique(sel_b)
+            cold_cents = (cents[~hot["lists"][cents]] if hot is not None
+                          else cents)
+            codes, cids, ccent, csh, crw = self._gather_codes(cold_cents)
+            C = codes.shape[0]
+            stats["gather_bytes"] += C * m
+            # pow-2 query bucket (same rule as the uncompressed path)
+            bq = min(_bucket(e - s, lo=8), _bucket(block, lo=8))
+            qblk = qvecs[s:e]
+            sel_pad = sel_b
+            if bq > e - s:
+                qblk = np.concatenate(
+                    [qblk, np.zeros((bq - (e - s), qvecs.shape[1]),
+                                    np.float32)])
+                sel_pad = np.concatenate(
+                    [sel_b, np.full((bq - (e - s), nprobe), -1, np.int32)])
+            q_dev = jnp.asarray(qblk)
+            lut = self.pq.lut(q_dev)
+            sel_dev = jnp.asarray(sel_pad)
+            parts = []            # (scores, page ids, src shard, src row)
+            if C:
+                cp = _bucket(C, lo=512)
+                if cp > C:
+                    codes = np.concatenate(
+                        [codes, np.zeros((cp - C, m), np.uint8)])
+                    ccent = np.concatenate(
+                        [ccent, np.full((cp - C,), -1, np.int32)])
+                cs, cpos = adc_topr(lut, jnp.asarray(codes),
+                                    jnp.asarray(ccent), sel_dev, r=r,
+                                    chunk=min(2048, cp))
+                cs, cpos = np.asarray(cs), np.asarray(cpos)
+                # a PADDING query (probed set all -1) "matches" padding
+                # candidates (cent -1): clip + mask so its garbage rows
+                # never reach the union gather
+                ok = (cpos >= 0) & (cpos < C)
+                idx = np.clip(cpos, 0, C - 1)
+                parts.append((np.where(ok, cs, -np.inf),
+                              np.where(ok, cids[idx], -1),
+                              np.where(ok, csh[idx], -1),
+                              np.where(ok, crw[idx], -1)))
+            if hot is not None and hot["rows"]:
+                hs, hpos = adc_topr(lut, hot["codes"], hot["cent"],
+                                    sel_dev, r=r, chunk=hot["chunk"])
+                hs, hpos = np.asarray(hs), np.asarray(hpos)
+                ok = (hpos >= 0) & (hpos < hot["rows"])
+                idx = np.clip(hpos, 0, hot["rows"] - 1)
+                parts.append((np.where(ok, hs, -np.inf),
+                              np.where(ok, hot["ids"][idx], -1),
+                              np.where(ok, hot["shard"][idx], -1),
+                              np.where(ok, hot["row"][idx], -1)))
+                res = hot["lists"][sel_b]
+                stats["hot_rows_scored"] = stats.get(
+                    "hot_rows_scored", 0) + int(
+                        self.list_sizes[sel_b][res].sum())
+            if not parts:
+                continue                        # out stays -inf / -1
+            scores = np.concatenate([p[0] for p in parts], axis=1)
+            pids = np.concatenate([p[1] for p in parts], axis=1)
+            shm = np.concatenate([p[2] for p in parts], axis=1)
+            rwm = np.concatenate([p[3] for p in parts], axis=1)
+            if scores.shape[1] > r:             # merge hot + cold survivors
+                ordx = np.argsort(-scores, axis=1, kind="stable")[:, :r]
+                take = lambda a: np.take_along_axis(a, ordx, axis=1)  # noqa: E731
+                scores, pids = take(scores), take(pids)
+                shm, rwm = take(shm), take(rwm)
+            ok = np.isfinite(scores) & (pids >= 0)
+            ok[e - s:] = False                  # padding queries: no gather
+            key = np.where(
+                ok, shm.astype(np.int64) * (1 << 32) + rwm.astype(np.int64),
+                np.int64(-1))
+            uniq = np.unique(key[ok])
+            if uniq.size == 0:
+                continue
+            rows, scl = self._fetch_rows(
+                (uniq >> 32).astype(np.int32),
+                (uniq & 0xFFFFFFFF).astype(np.int32))
+            stats["gather_bytes"] += int(uniq.size) * self.store.row_bytes
+            stats["reranked_rows"] = stats.get(
+                "reranked_rows", 0) + int(ok[: e - s].sum())
+            U = uniq.size
+            up = _bucket(U, lo=max(64, k))
+            if up > U:
+                rows = np.concatenate(
+                    [rows, np.zeros((up - U, rows.shape[1]), rows.dtype)])
+                if scl is not None:
+                    scl = np.concatenate(
+                        [scl, np.zeros((up - U,), scl.dtype)])
+            pos = np.where(ok, np.searchsorted(uniq, key), -1).astype(
+                np.int32)
+            uids = np.full((up,), -1, np.int64)
+            uids[pos[ok]] = pids[ok]            # union row -> page id
+            top_s, top_pos = rerank_positions(
+                q_dev, jnp.asarray(rows),
+                None if scl is None else jnp.asarray(scl),
+                jnp.asarray(pos), k)
+            top_s = np.asarray(top_s)[: e - s]
+            top_pos = np.asarray(top_pos)[: e - s]
+            kk = top_pos.shape[1]
+            out_i[s:e, :kk] = np.where(
+                top_pos >= 0, uids[np.clip(top_pos, 0, None)], -1)
+            out_s[s:e, :kk] = np.where(top_pos >= 0, top_s, -np.inf)
+        for key_, val in stats.items():
+            self.stats[key_] = self.stats.get(key_, 0) + val
         return out_s, out_i, stats

@@ -38,12 +38,16 @@ from dnn_page_vectors_tpu.utils.compat import (
 _PASS_CACHE: Dict[Tuple, object] = {}
 
 
-def _build_shard_pass(mesh: Mesh, nlist: int, chunk: int, scaled: bool):
+def _build_shard_pass(mesh: Mesh, nlist: int, chunk: int, scaled: bool,
+                      choices: int = 1):
     """Jitted (rows[, scales], valid, centroids) -> (sums [nlist, D] f32,
-    counts [nlist] f32, assign [rows] i32) with rows row-sharded over
-    'data' and sums/counts psummed (replicated). Assignments come back in
-    global row order; padding rows (>= valid) carry assignment -1 and
-    contribute nothing to sums/counts."""
+    counts [nlist] f32, assign i32) with rows row-sharded over 'data' and
+    sums/counts psummed (replicated). Assignments come back in global row
+    order; padding rows (>= valid) carry assignment -1 and contribute
+    nothing to sums/counts. `choices` > 1 returns each row's top-`choices`
+    centroids [rows, choices] instead of the bare argmax [rows] — the
+    balanced final-assignment sweep (docs/ANN.md) spills overflow rows to
+    their next choice; sums/counts always accumulate the FIRST choice."""
 
     def run(rows_local, scales_local, valid, centroids):
         rows = rows_local.shape[0]
@@ -79,21 +83,30 @@ def _build_shard_pass(mesh: Mesh, nlist: int, chunk: int, scaled: bool):
             s = jnp.matmul(rf, centroids.T,
                            precision=lax.Precision.HIGHEST,
                            preferred_element_type=jnp.float32)  # [c, nlist]
-            a = jnp.argmax(s, axis=1).astype(jnp.int32)
+            if choices > 1:
+                _, a_top = lax.top_k(s, min(choices, nlist))
+                a_top = a_top.astype(jnp.int32)
+                a = a_top[:, 0]
+            else:
+                a = jnp.argmax(s, axis=1).astype(jnp.int32)
+                a_top = a[:, None]
             ridx = ci * c + jnp.arange(c, dtype=jnp.int32)
             w = (ridx < valid_local).astype(jnp.float32)
             oh = jax.nn.one_hot(a, nlist, dtype=jnp.float32) * w[:, None]
             sums = sums + jnp.matmul(oh.T, rf,
                                      precision=lax.Precision.HIGHEST)
             counts = counts + oh.sum(axis=0)
-            return (sums, counts), jnp.where(ridx < valid_local, a, -1)
+            out = jnp.where((ridx < valid_local)[:, None], a_top, -1)
+            return (sums, counts), (out if choices > 1 else out[:, 0])
 
         (sums, counts), assign = lax.scan(
             body, init,
             (jnp.arange(n_chunks, dtype=jnp.int32), blocks, sblocks))
         sums = lax.psum(sums, "data")
         counts = lax.psum(counts, "data")
-        return sums, counts, assign.reshape(-1)[:rows]
+        assign = (assign.reshape(-1, choices)[:rows] if choices > 1
+                  else assign.reshape(-1)[:rows])
+        return sums, counts, assign
 
     if scaled:
         fn = run
@@ -109,15 +122,15 @@ def _build_shard_pass(mesh: Mesh, nlist: int, chunk: int, scaled: bool):
 
 
 def shard_pass(pages, scales, valid: int, centroids, mesh: Mesh,
-               nlist: int, chunk: int = 8192):
+               nlist: int, chunk: int = 8192, choices: int = 1):
     """One staged shard through the assignment/accumulation pass. `pages`
     and `scales` come from ops.topk.stage_shard (stored width, row-sharded);
     `centroids` is a replicated [nlist, D] f32 array."""
-    key = (mesh, int(nlist), int(chunk), scales is not None)
+    key = (mesh, int(nlist), int(chunk), scales is not None, int(choices))
     fn = _PASS_CACHE.get(key)
     if fn is None:
         fn = _PASS_CACHE[key] = _build_shard_pass(
-            mesh, nlist, chunk, scales is not None)
+            mesh, nlist, chunk, scales is not None, choices=choices)
     v = jnp.int32(valid)
     return (fn(pages, v, centroids) if scales is None
             else fn(pages, scales, v, centroids))
@@ -276,19 +289,130 @@ def train_kmeans(store, mesh: Mesh, nlist: int, iters: int = 8,
                                                     * len(store.shards())))}
 
 
+# -- grouped per-subspace k-means (the PQ codebook trainer, index/pq.py) ----
+
+_GROUPED_CACHE: Dict[Tuple, object] = {}
+
+
+def _build_grouped_pass(m: int, k: int, dsub: int, chunk: int):
+    """Jitted (X3 [n, m, dsub], valid, C [m, k, dsub]) ->
+    (sums [m, k, dsub] f32, counts [m, k] f32, assign [n, m] i32): one
+    EUCLIDEAN assignment + one-hot-accumulation pass over every subspace
+    at once, chunked through a lax.scan so device memory stays
+    O(chunk * m * k) — the same mini-batch MXU discipline as the coarse
+    quantizer above, minus the mesh (codebook pools are host-sample
+    sized). Euclidean, not spherical: sub-vectors of unit-norm rows are
+    NOT unit-norm, so argmin ||x-c||^2 = argmax (x.c - ||c||^2/2)."""
+
+    def run(x3, valid, cb):
+        n = x3.shape[0]
+        cn = -0.5 * jnp.sum(cb.astype(jnp.float32) ** 2, axis=-1)  # [m, k]
+        blocks = x3.reshape(n // chunk, chunk, m, dsub)
+
+        def body(carry, inp):
+            sums, counts = carry
+            ci, blk = inp                               # blk [chunk, m, dsub]
+            bf = blk.astype(jnp.float32)
+            s = jnp.einsum("cmd,mkd->cmk", bf, cb,
+                           precision=lax.Precision.HIGHEST) + cn[None]
+            a = jnp.argmax(s, axis=-1).astype(jnp.int32)        # [chunk, m]
+            ridx = ci * chunk + jnp.arange(chunk, dtype=jnp.int32)
+            w = (ridx < valid).astype(jnp.float32)
+            oh = jax.nn.one_hot(a, k, dtype=jnp.float32) * w[:, None, None]
+            sums = sums + jnp.einsum("cmk,cmd->mkd", oh, bf,
+                                     precision=lax.Precision.HIGHEST)
+            counts = counts + oh.sum(axis=0)
+            return (sums, counts), jnp.where(ridx[:, None] < valid, a, -1)
+
+        init = (jnp.zeros((m, k, dsub), jnp.float32),
+                jnp.zeros((m, k), jnp.float32))
+        (sums, counts), assign = lax.scan(
+            body, init,
+            (jnp.arange(n // chunk, dtype=jnp.int32), blocks))
+        return sums, counts, assign.reshape(-1, m)
+
+    return jax.jit(run)
+
+
+def _grouped_pass(x3: np.ndarray, valid: int, cb, chunk: int = 2048):
+    n, m, dsub = x3.shape
+    k = cb.shape[1]
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    if pad:
+        x3 = np.concatenate([x3, np.zeros((pad, m, dsub), x3.dtype)])
+    key = (int(m), int(k), int(dsub), int(chunk))
+    fn = _GROUPED_CACHE.get(key)
+    if fn is None:
+        fn = _GROUPED_CACHE[key] = _build_grouped_pass(m, k, dsub, chunk)
+    sums, counts, assign = fn(jnp.asarray(x3), jnp.int32(valid),
+                              jnp.asarray(cb, jnp.float32))
+    return sums, counts, assign[:valid]
+
+
+def grouped_kmeans(x3: np.ndarray, k: int, iters: int = 8, seed: int = 0,
+                   chunk: int = 2048) -> Tuple[np.ndarray, Dict]:
+    """Train `m` independent k-means codebooks — one per PQ subspace —
+    over the pool `x3` [n, m, dsub], all subspaces per pass (index/pq.py,
+    docs/ANN.md). Seeded and byte-deterministic for a given (pool bytes,
+    k, iters, seed): seeded distinct-row init per subspace, seeded
+    empty-cluster reseed, fixed chunk reduction order. Returns
+    (codebooks [m, k, dsub] f32, stats)."""
+    n, m, dsub = x3.shape
+    if k > n:
+        raise ValueError(f"PQ codebook k={k} exceeds pool size {n}")
+    x3 = np.asarray(x3, np.float32)
+    skey = (tuple(int(s) for s in seed)
+            if isinstance(seed, (tuple, list)) else (int(seed),))
+    rng = np.random.default_rng(skey)
+    cb = np.stack([x3[np.sort(rng.choice(n, size=k, replace=False)), j]
+                   for j in range(m)])                     # [m, k, dsub]
+    reseeded = 0
+    for it in range(max(1, iters)):
+        sums, counts, _ = _grouped_pass(x3, n, cb, chunk=chunk)
+        sums = np.asarray(sums, np.float64)
+        counts = np.asarray(counts, np.float64)
+        new = cb.astype(np.float64).copy()
+        nz = counts > 0
+        new[nz] = sums[nz] / counts[nz][:, None]
+        empty = np.argwhere(~nz)
+        if empty.size:                 # reseed dead codewords from the pool
+            r2 = np.random.default_rng([*skey, 2, it])
+            rows = r2.integers(0, n, empty.shape[0])
+            for (j, c), r in zip(empty, rows):
+                new[j, c] = x3[r, j]
+            reseeded += int(empty.shape[0])
+        cb = new.astype(np.float32)
+    return cb, {"k": int(k), "iters": int(max(1, iters)),
+                "reseeded": reseeded}
+
+
+def grouped_assign(x3: np.ndarray, cb: np.ndarray,
+                   chunk: int = 2048) -> np.ndarray:
+    """Nearest-codeword id per (row, subspace): [n, m] i32 — the PQ
+    encode assignment, same compiled pass as the trainer."""
+    if x3.shape[0] == 0:
+        return np.zeros((0, cb.shape[0]), np.int32)
+    _, _, assign = _grouped_pass(np.asarray(x3, np.float32), x3.shape[0],
+                                 cb, chunk=chunk)
+    return np.asarray(assign, np.int32)
+
+
 def assign_store(store, mesh: Mesh, centroids: np.ndarray,
-                 chunk: int = 8192, entries=None
+                 chunk: int = 8192, entries=None, choices: int = 1
                  ) -> Iterator[Tuple[Dict, np.ndarray]]:
-    """Final assignment sweep: yield (shard entry, assign [count] i32) for
-    every non-empty shard, streaming one shard at a time through the same
+    """Final assignment sweep: yield (shard entry, assign i32) for every
+    non-empty shard, streaming one shard at a time through the same
     compiled pass the trainer used (sums/counts are discarded). `entries`
     restricts the sweep to a shard subset — the incremental index update
-    assigns ONLY the new generation's shards this way (docs/UPDATES.md)."""
+    assigns ONLY the new generation's shards this way (docs/UPDATES.md).
+    `choices` > 1 yields each row's top-`choices` centroids
+    [count, choices] for the balanced-assignment spill (docs/ANN.md)."""
     nlist = centroids.shape[0]
     rows = _padded_rows(store, mesh)
     cdev = jnp.asarray(centroids, jnp.float32)
     for entry, n, pages, scales in _iter_staged(store, mesh, rows,
                                                 entries=entries):
         _, _, assign = shard_pass(pages, scales, n, cdev, mesh, nlist,
-                                  chunk=chunk)
+                                  chunk=chunk, choices=choices)
         yield entry, np.asarray(assign, np.int32)[:n]

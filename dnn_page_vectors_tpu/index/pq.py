@@ -1,0 +1,226 @@
+"""OPQ+PQ codec: compressed posting payloads for the IVF index
+(docs/ANN.md). The IVF candidate gather is the serving bottleneck at
+scale — it moves STORED-width rows (1 B/dim at int8, 2 B/dim at fp16)
+over the host mmap path per query. Product quantization (Jegou et al.
+2011) cuts that to `m` bytes/row: split the rotated vector into `m`
+subspaces of `dsub = D/m` dims, train a 256-codeword codebook per
+subspace (so one code byte per subspace), and score candidates with
+asymmetric distance computation (ADC) — per query, one [m, 256] lookup
+table of query-subvector x codeword dot products, then each candidate's
+score is m table lookups instead of a D-wide matmul row. The optimized
+rotation (Ge et al., OPQ, 2013) alternates Procrustes rotation solves
+with codebook re-training so the subspace split loses less signal than
+a naive coordinate split.
+
+Division of labor with the rest of `index/`:
+
+  * codebooks train on the SAME mini-batch MXU k-means machinery as the
+    coarse quantizer — `index.kmeans.grouped_kmeans` runs every
+    subspace's Euclidean assignment + one-hot accumulation per chunked
+    pass — over the store's seeded sample pool (`sample_rows`), so PQ
+    builds inherit the streamed, seeded, byte-deterministic build
+    discipline (test-pinned, tests/test_pq.py);
+  * `ivf.py` persists the rotation / codebooks / per-shard code files
+    under the store's manifest+CRC machinery and runs the ADC search
+    path (codes gathered at m B/row, on-device LUT + running top-r, the
+    exact re-rank from stored-width rows kept for the final top-k so the
+    recall@10 >= 0.95 contract is measured on real scores, not codes).
+
+Scores are INNER-PRODUCT ADC: rows are unit-norm (store invariant) and
+the rotation is orthogonal, so q.x = (qR).(xR) ~= sum_m (qR)_m . c_m —
+the reconstruction error is bounded by the per-subspace quantization
+error, and the exact re-rank erases it for the returned top-k.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dnn_page_vectors_tpu.index.kmeans import (
+    grouped_assign, grouped_kmeans, sample_rows)
+
+KSUB = 256                      # codewords per subspace: one uint8 per code
+
+
+def auto_pq_m(dim: int) -> int:
+    """Default subspace count for `cli index --pq`: ~8 dims per subspace
+    (the faiss-style operating point — m bytes/row at 256 codewords),
+    falling back to coarser splits for dims 8 doesn't divide."""
+    for dsub in (8, 6, 4, 2, 1):
+        if dim % dsub == 0:
+            return dim // dsub
+    return dim
+
+
+@jax.jit
+def _pq_lut(q: jnp.ndarray, rotation: jnp.ndarray, codebooks: jnp.ndarray
+            ) -> jnp.ndarray:
+    """Per-query ADC lookup tables, on device: rotate q [B, D], split into
+    subspaces, dot every codeword — [B, m, ksub] f32. One einsum; the
+    whole table is ~m*256 floats per query."""
+    m, _, dsub = codebooks.shape
+    qr = jnp.matmul(q, rotation, precision=lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
+    q3 = qr.reshape(q.shape[0], m, dsub)
+    return jnp.einsum("bmd,mkd->bmk", q3, codebooks,
+                      precision=lax.Precision.HIGHEST)
+
+
+@partial(jax.jit, static_argnames=("r", "chunk"))
+def adc_topr(lut: jnp.ndarray, codes: jnp.ndarray, cent: jnp.ndarray,
+             selected: jnp.ndarray, r: int, chunk: int = 2048
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Running top-`r` ADC scores of every candidate code row against
+    every query: lut [B, m, ksub], codes [C, m] uint8 (C % chunk == 0),
+    cent [C] i32 (the candidate's posting list; -1 padding, -2 dead),
+    selected [B, nprobe] (each query's probed lists). Per chunk the code
+    bytes expand to a multi-hot [chunk, m*ksub] matrix and ONE MXU matmul
+    against the flattened tables scores the block — the same
+    one-hot-matmul idiom as the k-means accumulation pass — masked so a
+    query only scores candidates from ITS probed lists, then merged into
+    a running top-r exactly like ops.topk._topk_scan. Returns
+    (scores [B, r] f32, positions into C [B, r] i32, -1 padded)."""
+    B, m, ksub = lut.shape
+    C = codes.shape[0]
+    chunk = min(chunk, C)
+    flat = lut.reshape(B, m * ksub)
+    blocks = codes.reshape(C // chunk, chunk, m)
+    cblocks = cent.reshape(C // chunk, chunk)
+    offs_base = jnp.arange(m, dtype=jnp.int32) * ksub
+
+    def body(carry, inp):
+        best_s, best_i = carry
+        ci, blk, centblk = inp
+        offs = blk.astype(jnp.int32) + offs_base[None, :]    # [chunk, m]
+        oh = jnp.zeros((chunk, m * ksub), jnp.bfloat16).at[
+            jnp.arange(chunk)[:, None], offs].set(1)
+        s = jnp.matmul(flat, oh.T, precision=lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)   # [B, chunk]
+        hit = centblk[None, :] == selected[:, 0:1]
+        for p in range(1, selected.shape[1]):
+            hit = hit | (centblk[None, :] == selected[:, p:p + 1])
+        s = jnp.where(hit, s, -jnp.inf)
+        ids = ci * chunk + jnp.arange(chunk, dtype=jnp.int32)
+        cat_s = jnp.concatenate([best_s, s], axis=1)
+        cat_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(ids[None], (B, chunk))], axis=1)
+        top_s, pos = lax.top_k(cat_s, r)
+        top_i = jnp.take_along_axis(cat_i, pos, axis=1)
+        top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
+        return (top_s, top_i), None
+
+    init = (jnp.full((B, r), -jnp.inf, jnp.float32),
+            jnp.full((B, r), -1, jnp.int32))
+    (scores, pos), _ = lax.scan(
+        body, init,
+        (jnp.arange(C // chunk, dtype=jnp.int32), blocks, cblocks))
+    return scores, pos
+
+
+class PQCodec:
+    """A trained OPQ rotation + per-subspace codebooks. Encoding and the
+    LUT run through jitted device passes; the arrays themselves are tiny
+    (D^2 + m*256*dsub floats) and persist as two npy files next to the
+    posting lists (ivf.py)."""
+
+    def __init__(self, rotation: np.ndarray, codebooks: np.ndarray):
+        self.rotation = np.ascontiguousarray(rotation, dtype=np.float32)
+        self.codebooks = np.ascontiguousarray(codebooks, dtype=np.float32)
+        self._dev: Optional[Tuple] = None
+
+    @property
+    def dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def m(self) -> int:
+        return self.codebooks.shape[0]
+
+    @property
+    def ksub(self) -> int:
+        return self.codebooks.shape[1]
+
+    @property
+    def dsub(self) -> int:
+        return self.codebooks.shape[2]
+
+    def device(self) -> Tuple:
+        """(rotation, codebooks) as device arrays, cached."""
+        if self._dev is None:
+            self._dev = (jnp.asarray(self.rotation),
+                         jnp.asarray(self.codebooks))
+        return self._dev
+
+    def encode(self, vecs: np.ndarray) -> np.ndarray:
+        """f32 rows [n, D] -> PQ codes [n, m] uint8 (nearest codeword per
+        rotated subspace, through the chunked grouped-assignment pass)."""
+        x = np.asarray(vecs, np.float32)
+        xr = x @ self.rotation
+        codes = grouped_assign(xr.reshape(-1, self.m, self.dsub),
+                               self.codebooks)
+        return codes.astype(np.uint8)
+
+    def lut(self, q_dev) -> jnp.ndarray:
+        """Device ADC tables [B, m, ksub] for device queries [B, D]."""
+        rot, cb = self.device()
+        return _pq_lut(q_dev, rot, cb)
+
+    def reconstruct(self, codes: np.ndarray) -> np.ndarray:
+        """Decode codes [n, m] back to approximate f32 rows [n, D] (the
+        rotation is orthogonal, so decode = codewords @ R^T). Test/debug
+        aid; the search path never materializes reconstructions."""
+        c = np.asarray(codes, np.int64)
+        recon = self.codebooks[np.arange(self.m)[None, :], c]
+        return recon.reshape(-1, self.dim) @ self.rotation.T
+
+
+def train_pq(store, m: int, ksub: int = KSUB, iters: int = 8,
+             opq_iters: int = 3, seed: int = 0,
+             sample: int = 65_536) -> Tuple[PQCodec, Dict]:
+    """Train an OPQ rotation + PQ codebooks over the store's seeded
+    sample pool. Alternation (Ge et al. 2013): train codebooks in the
+    current rotation (grouped_kmeans, the MXU pass), reconstruct the
+    pool from its codes, solve the orthogonal Procrustes problem
+    R = UV^T from SVD(X^T X_hat) for the rotation that best aligns the
+    data with its reconstruction, repeat; identity rotation to start
+    (opq_iters=0 is plain PQ). Deterministic for a given (store bytes,
+    m, iters, opq_iters, seed, backend). Returns (codec, stats)."""
+    t0 = time.perf_counter()
+    D = store.dim
+    if D % m:
+        raise ValueError(f"pq_m={m} must divide the store dim {D}")
+    N = store.num_vectors
+    if N == 0:
+        raise ValueError("cannot train PQ codebooks over an empty store")
+    pool = sample_rows(store, max(2, min(sample, N)), seed)
+    n = pool.shape[0]
+    k = min(int(ksub), n)
+    dsub = D // m
+    R = np.eye(D, dtype=np.float32)
+    reseeded = 0
+    for t in range(max(0, int(opq_iters))):
+        xr = pool @ R
+        cb, st = grouped_kmeans(xr.reshape(n, m, dsub), k, iters=iters,
+                                seed=(seed, 3, t))
+        reseeded += st["reseeded"]
+        codes = grouped_assign(xr.reshape(n, m, dsub), cb)
+        recon = cb[np.arange(m)[None, :], codes].reshape(n, D)
+        u, _, vt = np.linalg.svd(pool.T.astype(np.float64)
+                                 @ recon.astype(np.float64))
+        R = np.ascontiguousarray((u @ vt).astype(np.float32))
+    xr = pool @ R
+    cb, st = grouped_kmeans(xr.reshape(n, m, dsub), k, iters=iters,
+                            seed=(seed, 3, max(0, int(opq_iters))))
+    codec = PQCodec(R, cb)
+    stats = {"m": int(m), "ksub": int(k), "dsub": int(dsub),
+             "iters": int(iters), "opq_iters": int(opq_iters),
+             "seed": int(seed), "pool": int(n),
+             "reseeded": reseeded + st["reseeded"],
+             "train_seconds": round(time.perf_counter() - t0, 3)}
+    return codec, stats

@@ -35,7 +35,11 @@ the exact path when the index is missing, stale against the store's model
 step, or quarantined. `ann_lists_scanned` / `ann_candidates_reranked` /
 `ann_fallbacks` and the active index config surface through metrics().
 The default `serve.index = "exact"` keeps the pre-index paths below
-byte-identical.
+byte-identical. On a PQ index (built with `cli index --pq`, docs/ANN.md)
+the candidate gather moves m-byte codes with on-device ADC scoring and
+an exact re-rank, and `serve.hot_postings_gb` stages the hot posting
+set's codes to device at view build time — resident lists answer with
+zero per-request host gather (`ann_gather_bytes` measures what moves).
 
 HBM pre-staging: when the store fits the configured budget, every shard is
 device_put once (row-sharded over the mesh 'data' axis, padded to one
@@ -53,7 +57,11 @@ it with a single reference assignment: in-flight search_many buckets
 finish on the view they captured, the next bucket sees the new corpus —
 zero downtime, no dropped futures, never a mixed result set. metrics()
 reports `store_generation` / `index_generation` / `docs_appended` /
-`tombstoned` / `incremental_updates` / `full_rebuilds`.
+`tombstoned` / `incremental_updates` / `full_rebuilds`. Restaging is
+tombstone-aware (`updates.restage_tombstone_density`): a staged shard
+whose only drift is a few new tombstones is reused with the dead rows
+masked in its id table, and restages compacted once the staged block's
+dead density crosses the threshold (`restage_skipped`/`restage_forced`).
 
 Degradation (docs/ROBUSTNESS.md): a shard that FAILS to stage — an I/O
 fault during the device_put, a checksum mismatch, or the HBM budget
@@ -235,19 +243,37 @@ class SearchService:
                              if serve_cfg is not None else "exact")
         self._nprobe = (getattr(serve_cfg, "nprobe", 8)
                         if serve_cfg is not None else 8)
+        # PQ/ADC knobs (docs/ANN.md): exact-rerank depth per query (0 =
+        # the index default) and the HBM budget for the resident hot
+        # posting set — staged at view build, so resident lists answer
+        # with zero per-request host gather
+        self._pq_rerank = (getattr(serve_cfg, "pq_rerank", 0)
+                           if serve_cfg is not None else 0)
+        self._hot_gb = (getattr(serve_cfg, "hot_postings_gb", 0.0)
+                        if serve_cfg is not None else 0.0)
         upd_cfg = getattr(cfg, "updates", None)
         self._rebuild_drift = (getattr(upd_cfg, "rebuild_drift", 0.25)
                                if upd_cfg is not None else 0.25)
         self._auto_update_index = (
             getattr(upd_cfg, "auto_update_index", True)
             if upd_cfg is not None else True)
+        self._restage_density = (
+            getattr(upd_cfg, "restage_tombstone_density", 0.05)
+            if upd_cfg is not None else 0.05)
         self.ann_lists_scanned = 0
         self.ann_candidates_reranked = 0
         self.ann_fallbacks = 0
+        self.ann_gather_bytes = 0
         # live-update counters (docs/UPDATES.md)
         self.refreshes = 0
         self.incremental_updates = 0
         self.full_rebuilds = 0
+        # tombstone-aware restage policy counters (docs/UPDATES.md):
+        # skipped = staged shard reused with its new dead rows masked in
+        # the id table; forced = dead density crossed the threshold and
+        # the shard restaged compacted
+        self.restage_skipped = 0
+        self.restage_forced = 0
         self._batcher: Optional[_MicroBatcher] = None
         self._batch_sizes: List[int] = []   # telemetry after close()
         self._log = log
@@ -404,6 +430,22 @@ class SearchService:
             else:
                 view.index = IVFIndex.open(view.store)
             view.index_error = None
+            if (view.index is not None and view.index.pq is not None
+                    and self._hot_gb > 0):
+                # HBM-resident hot posting set (docs/ANN.md): staged per
+                # VIEW — a refresh re-opens the index, so the staged codes
+                # (and their tombstone masks) follow the same hot-swap
+                # cadence as the staged store shards. A staging failure
+                # costs the residency, never the index.
+                try:
+                    hot = view.index.stage_hot(self._hot_gb * 2 ** 30)
+                    if view.index_info is not None:
+                        view.index_info = {**view.index_info, **hot}
+                except Exception as e:  # noqa: BLE001
+                    self._count_fault("serve_hot_stage_faults")
+                    faults.warn(f"hot posting staging failed "
+                                f"({type(e).__name__}: {e}); serving the "
+                                "mmap gather path")
         except IndexUnavailable as e:
             view.index = None
             view.index_error = str(e)
@@ -431,8 +473,9 @@ class SearchService:
         prof = self.profiler
         try:
             with prof.stage("topk"):
-                scores, ids, st = idx.search(qv[:n], k=k,
-                                             nprobe=self._nprobe)
+                scores, ids, st = idx.search(
+                    qv[:n], k=k, nprobe=self._nprobe,
+                    rerank=self._pq_rerank or None)
         except Exception as e:  # noqa: BLE001 — any index failure degrades
             view.index = None
             view.index_error = f"{type(e).__name__}: {e}"
@@ -441,6 +484,7 @@ class SearchService:
             return None
         self.ann_lists_scanned += st.get("lists_scanned", 0)
         self.ann_candidates_reranked += st.get("candidates_reranked", 0)
+        self.ann_gather_bytes += st.get("gather_bytes", 0)
         with prof.stage("format"):
             return [self._format(scores[i], ids[i]) for i in range(n)]
 
@@ -476,16 +520,33 @@ class SearchService:
                 if hit is not None:
                     old_ids, old_n, pages, scl = hit
                     ids = store.load_ids(entry)
-                    ids = np.asarray(ids[ids >= 0], np.int64)
-                    # the device rows were compacted against the STAGING-
-                    # time tombstone set: reuse only when the masked ids
-                    # match exactly, else fall through and restage (a new
-                    # tombstone landed in this shard)
-                    if np.array_equal(ids, old_ids):
+                    live = np.asarray(ids[ids >= 0], np.int64)
+                    alive_old = old_ids[old_ids >= 0]
+                    if np.array_equal(live, alive_old):
+                        # staged block current (modulo rows already masked
+                        # by an earlier skip): plain reuse
                         staged.append((old_ids, old_n, pages, scl))
                         keys.append(key)
                         used += per_shard
                         continue
+                    # tombstone-aware restage policy (docs/UPDATES.md):
+                    # key equality pins the shard BYTES, so the only
+                    # possible drift is newer tombstones. Below the
+                    # density threshold the staged block is REUSED with
+                    # the dead rows masked in its id table — they can
+                    # still occupy a per-shard top-k slot (one result
+                    # short, bounded by the threshold) but never surface;
+                    # past the threshold the shard restages compacted.
+                    dead_frac = (old_n - live.size) / max(old_n, 1)
+                    if dead_frac <= self._restage_density:
+                        masked = np.where(np.isin(old_ids, live),
+                                          old_ids, np.int64(-1))
+                        staged.append((masked, old_n, pages, scl))
+                        keys.append(key)
+                        used += per_shard
+                        self.restage_skipped += 1
+                        continue
+                    self.restage_forced += 1   # falls through: restage
                 plan.check("hbm_stage")
                 err = store.entry_error(entry)
                 if err is not None:
@@ -699,6 +760,9 @@ class SearchService:
             "refreshes": self.refreshes,
             "incremental_updates": self.incremental_updates,
             "full_rebuilds": self.full_rebuilds,
+            # tombstone-aware restage policy (docs/UPDATES.md)
+            "restage_skipped": self.restage_skipped,
+            "restage_forced": self.restage_forced,
             **self.profiler.summary(prefix="serve_stage_"),
         }
         sizes = (self._batcher.batch_sizes if self._batcher is not None
@@ -713,10 +777,16 @@ class SearchService:
             rec["ann_lists_scanned"] = self.ann_lists_scanned
             rec["ann_candidates_reranked"] = self.ann_candidates_reranked
             rec["ann_fallbacks"] = self.ann_fallbacks
+            # store payload bytes the ANN gather actually moved (codes +
+            # rerank rows on a PQ index, stored-width rows otherwise) —
+            # the bandwidth denominator behind ann_gather_mbytes_per_s
+            rec["ann_gather_bytes"] = self.ann_gather_bytes
             rec["ann_index"] = {
                 "index": self._serve_index, "nprobe": self._nprobe,
                 "nlist": self._index.nlist if self._index else None,
                 "available": self._index is not None,
+                "pq_m": self._index.pq_m if self._index else 0,
+                "hot_rows": self._index.hot_rows if self._index else 0,
                 **({"error": self._index_error}
                    if self._index_error else {})}
         if self.fault_counters:

@@ -256,6 +256,15 @@ class VectorStore:
         return sum(s["count"] for s in self.shards())
 
     @property
+    def row_bytes(self) -> int:
+        """Bytes one row costs to gather at STORED width (int8 codes +
+        fp16 scale, or fp16 rows) — the per-shard HBM staging unit
+        (infer/serve.py) and the payload-accounting unit behind the ANN
+        gather metrics (`ann_gather_bytes`, docs/ANN.md)."""
+        return (self.dim + 2 if self.manifest["dtype"] == "int8"
+                else self.dim * 2)
+
+    @property
     def model_step(self) -> Optional[int]:
         """The model step this store's vectors were embedded at (None for a
         pre-stamp store). Serving keys its query-embedding cache on this, so

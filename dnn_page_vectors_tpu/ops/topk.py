@@ -213,6 +213,31 @@ def rerank_candidates(q: jnp.ndarray, cand, scales, cand_cent: jnp.ndarray,
     return top_s, pos
 
 
+@partial(jax.jit, static_argnames=("k",))
+def rerank_positions(q: jnp.ndarray, cand, scales, pos: jnp.ndarray, k: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact top-k over PER-QUERY candidate positions into one gathered
+    block — the final stage of the PQ/ADC path (index/pq.py, docs/ANN.md):
+    `cand` [U, D] holds the union of every query's ADC-surviving rows at
+    STORED width (fp16 rows or int8 codes with per-row `scales`, widening
+    fused into the matmul exactly like _topk_scan), and `pos` [B, R] maps
+    each query to ITS candidates (-1 = empty slot). One [B, U] matmul
+    scores the whole block, take_along_axis keeps each query's own R, and
+    lax.top_k picks the winners. Returns (scores [B, k], positions into
+    `cand` [B, k], -1 where fewer than k candidates survived)."""
+    s = jnp.matmul(q, cand.T.astype(jnp.float32),
+                   precision=lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32)          # [B, U]
+    if scales is not None:
+        s = s * scales.astype(jnp.float32)[None, :]
+    sp = jnp.take_along_axis(s, jnp.clip(pos, 0, None), axis=1)  # [B, R]
+    sp = jnp.where(pos >= 0, sp, -jnp.inf)
+    top_s, rpos = lax.top_k(sp, min(k, sp.shape[1]))
+    out_pos = jnp.take_along_axis(pos, jnp.clip(rpos, 0, None), axis=1)
+    out_pos = jnp.where(jnp.isfinite(top_s), out_pos, -1)
+    return top_s, out_pos
+
+
 def merge_topk_host(best_s: np.ndarray, best_i: np.ndarray,
                     new_s: np.ndarray, new_i: np.ndarray
                     ) -> Tuple[np.ndarray, np.ndarray]:

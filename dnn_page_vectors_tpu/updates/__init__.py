@@ -10,7 +10,9 @@ store/index/serve stack mutable end to end:
     (infer/vector_store.py GenerationWriter);
   * `IVFIndex.update` (index/ivf.py) assigns only the new generation's
     shards to the existing centroids — O(new shards), not O(corpus) —
-    until accumulated drift triggers a full k-means rebuild;
+    until accumulated drift triggers a full k-means rebuild; on a PQ
+    index (docs/ANN.md) the new shards' codes encode with the existing
+    rotation/codebooks, the same O(new shards) append;
   * `SearchService.refresh` (infer/serve.py) atomically swaps the new
     store view + index generation under live traffic.
 """

@@ -373,6 +373,45 @@ def test_posting_append_fault_degrades_to_exact_with_counters(env, tmp_path):
     svc.close()
 
 
+def test_tombstone_aware_restage_policy(env, tmp_path):
+    """The restage policy (updates.restage_tombstone_density,
+    docs/UPDATES.md): a refresh after a SMALL tombstone burst reuses the
+    staged device shards (restage_skipped counted, dead rows masked in
+    the id table — the victim never surfaces), while a burst past the
+    density threshold forces a compacted restage (restage_forced) whose
+    results match a fresh exact service bit for bit."""
+    import dataclasses
+    emb, trainer = env["emb"], env["trainer"]
+    store = _copy_store(env, tmp_path)
+    cfg = env["cfg"].replace(updates=dataclasses.replace(
+        env["cfg"].updates, restage_tombstone_density=0.05))
+    svc = SearchService(cfg, emb, trainer.corpus, store, preload_hbm_gb=4.0)
+    assert svc.preloaded
+    # 1 dead row of 100 in shard 0 (1% <= 5%): reuse with masking
+    append_corpus(emb, trainer.corpus, store, tombstone=[7])
+    svc.refresh()
+    assert svc.restage_skipped >= 1 and svc.restage_forced == 0
+    met = svc.metrics()
+    assert met["restage_skipped"] == svc.restage_skipped
+    # the dead row's device copy was NOT restaged — the id-table masking
+    # alone must keep it from ever surfacing, even for its gold query
+    res = svc.search(trainer.corpus.query_text(7), k=10)
+    assert all(r["page_id"] != 7 for r in res)
+    # 10 more dead rows in shard 0 (11% > 5%): forced compacted restage
+    append_corpus(emb, trainer.corpus, store,
+                  tombstone=list(range(10, 20)))
+    svc.refresh()
+    assert svc.restage_forced >= 1
+    fresh = SearchService(cfg, emb, trainer.corpus,
+                          VectorStore(store.directory), preload_hbm_gb=4.0)
+    queries = [trainer.corpus.query_text(i) for i in (2, 77, 290)]
+    got = svc.search_many(queries, k=10)
+    want = fresh.search_many(queries, k=10)
+    assert [[r["page_id"] for r in g] for g in got] == \
+        [[r["page_id"] for r in w] for w in want]
+    svc.close()
+
+
 def test_quarantine_plus_append_never_double_assigns(env, tmp_path):
     """The no-double-assign contract: a quarantined base shard leaves its
     id-range discoverable (missing_id_ranges), the append cursor skips it,
