@@ -323,6 +323,11 @@ def run_worker() -> None:
                 with lat.timed():
                     return svc.search(qtexts[i % distinct], k=kq)
 
+            # burst 1 (sequential, above) vs burst 2 (batched): the
+            # windowed registry gauges move between the two — proof the
+            # live SLO view (docs/OBSERVABILITY.md) tracks traffic, while
+            # the wall-clock serve_qps/serve_p99_ms keys stay authoritative
+            win_after_seq = svc.metrics()["serve_window_qps"]
             t0 = time.perf_counter()
             with concurrent.futures.ThreadPoolExecutor(conc) as ex:
                 list(ex.map(_one, range(n_q)))
@@ -342,6 +347,16 @@ def run_worker() -> None:
                 "serve_distinct_queries": distinct,
                 "serve_store_vectors": sstore.num_vectors,
                 "serve_mean_batch": smet.get("serve_mean_batch"),
+                # the registry's live windowed view (docs/OBSERVABILITY.md)
+                # — read from the SAME instruments tests and serve-metrics
+                # exposition read, not recomputed here
+                "serve_window_s": smet["serve_window_s"],
+                "serve_window_qps": smet["serve_window_qps"],
+                "serve_window_qps_after_seq_burst": round(win_after_seq, 3),
+                "serve_window_p50_ms": smet["serve_window_p50_ms"],
+                "serve_window_p99_ms": smet["serve_window_p99_ms"],
+                "serve_window_cache_hit_rate":
+                    smet["serve_window_cache_hit_rate"],
                 "serve_stage_seconds": {
                     key: round(val, 3)
                     for key, val in sorted(sprof.stages().items())},

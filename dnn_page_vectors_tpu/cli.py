@@ -13,6 +13,8 @@
   python -m dnn_page_vectors_tpu.cli append --config cdssm_toy \
       --set data.num_pages=12000 --tombstone 17,42
   python -m dnn_page_vectors_tpu.cli refresh --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli trace --config cdssm_toy --query "..."
+  python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy
 
 Any config field is overridable with --set section.field=value; every flag
 round-trips through the Config dataclasses (SURVEY.md §5.6).
@@ -107,7 +109,8 @@ def main(argv=None) -> None:
                                         "search", "pipeline", "configs",
                                         "init-store", "merge-store",
                                         "reset-store", "index", "append",
-                                        "refresh"])
+                                        "refresh", "trace",
+                                        "serve-metrics"])
     ap.add_argument("--tombstone", default=None, metavar="IDS",
                     help="append: comma-separated page ids to DELETE (their "
                          "vectors mask out of every retrieval path)")
@@ -150,6 +153,9 @@ def main(argv=None) -> None:
                     help="embed: one-past-last page id (shard aligned)")
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace under workdir/trace")
+    ap.add_argument("--json", action="store_true",
+                    help="serve-metrics: emit the JSON registry snapshot "
+                         "instead of the Prometheus text exposition")
     ap.add_argument("--faults", default=None, metavar="PLAN",
                     help="fault-injection plan 'op:kind:at[:count],...' "
                          "(utils/faults.py; shorthand for --set "
@@ -164,6 +170,8 @@ def main(argv=None) -> None:
                                          or args.interactive):
         ap.error("search requires --query TEXT, --queries FILE, "
                  "or --interactive")
+    if args.command == "trace" and not (args.query or args.queries):
+        ap.error("trace requires --query TEXT or --queries FILE")
 
     cfg = get_config(args.config, _parse_overrides(args.overrides))
     if args.workdir:
@@ -410,6 +418,7 @@ def main(argv=None) -> None:
             raise SystemExit("append is a single-process job (one "
                              "generation writer); run it on one host")
         from dnn_page_vectors_tpu.updates import append_corpus
+        from dnn_page_vectors_tpu.utils import telemetry
         from dnn_page_vectors_tpu.utils.logging import MetricsLogger
         try:
             store = VectorStore(store_dir)
@@ -427,9 +436,11 @@ def main(argv=None) -> None:
         upd = [int(x) for x in (args.update_ids or "").split(",")
                if x.strip()]
         with maybe_profile(args.profile, cfg.workdir):
-            stats = append_corpus(embedder, trainer.corpus, store,
-                                  tombstone=tomb, update_ids=upd,
-                                  log=MetricsLogger(cfg.workdir, echo=False))
+            stats = append_corpus(
+                embedder, trainer.corpus, store, tombstone=tomb,
+                update_ids=upd,
+                log=MetricsLogger(cfg.workdir, echo=False,
+                                  registry=telemetry.default_registry()))
         index_info = None
         from dnn_page_vectors_tpu.index.ivf import (
             MANIFEST as _IVF_MANIFEST, IVFIndex, index_dir)
@@ -486,11 +497,13 @@ def main(argv=None) -> None:
         # one-shot queries stream shard-at-a-time (a full HBM preload for a
         # single answer is waste); --interactive / --queries pre-stage the
         # store (a batch file or a stdin session amortizes the staging)
+        from dnn_page_vectors_tpu.utils import telemetry
         from dnn_page_vectors_tpu.utils.logging import MetricsLogger
         preload = 4.0 if (args.interactive or args.queries) else 0.0
-        svc = SearchService(cfg, embedder, trainer.corpus, store,
-                            preload_hbm_gb=preload,
-                            log=MetricsLogger(cfg.workdir, echo=False))
+        svc = SearchService(
+            cfg, embedder, trainer.corpus, store, preload_hbm_gb=preload,
+            log=MetricsLogger(cfg.workdir, echo=False,
+                              registry=telemetry.default_registry()))
         if args.queries:
             # batch mode: every line is a query; the whole file goes through
             # ONE search_many (bucket-filling tiled dispatch), one JSON
@@ -522,6 +535,13 @@ def main(argv=None) -> None:
                     print(json.dumps({"refreshed": svc.refresh()},
                                      sort_keys=True), flush=True)
                     continue
+                if query == ":metrics":
+                    # live JSON snapshot of the serving registry (docs/
+                    # OBSERVABILITY.md): flat metrics + typed instruments
+                    # with windowed qps/p99 + the lifecycle event ring
+                    print(json.dumps(svc.metrics_snapshot(),
+                                     sort_keys=True), flush=True)
+                    continue
                 print(json.dumps({"query": query,
                                   "results": svc.search(query, k=k)}),
                       flush=True)
@@ -530,6 +550,44 @@ def main(argv=None) -> None:
             print(json.dumps({"query": args.query,
                               "degraded": svc.degraded,
                               "results": svc.search(args.query, k=k)}))
+    elif args.command in ("trace", "serve-metrics"):
+        # Observability endpoints (docs/OBSERVABILITY.md). `trace` runs the
+        # given queries under request-scoped tracing and exports the span
+        # trees as Chrome/Perfetto trace_event JSON; `serve-metrics` probes
+        # the service once and prints the Prometheus text exposition (or
+        # the JSON registry snapshot with --json).
+        if pi != 0:
+            return
+        from dnn_page_vectors_tpu.infer.serve import SearchService
+        store = VectorStore(store_dir)
+        svc = SearchService(cfg, embedder, trainer.corpus, store,
+                            preload_hbm_gb=0.0)
+        k = args.topk or cfg.eval.recall_k
+        if args.command == "serve-metrics":
+            # one probe query so rate/latency instruments expose live
+            # numbers, not an all-zero registry
+            svc.search_many([trainer.corpus.query_text(0)], k=k)
+            if args.json:
+                print(json.dumps(svc.metrics_snapshot(), sort_keys=True))
+            else:
+                print(svc.prometheus_text(), end="")
+            return
+        if args.queries:
+            with open(args.queries) as f:
+                queries = [ln.strip() for ln in f if ln.strip()]
+        else:
+            queries = [args.query]
+        for query in queries:       # one trace (and one span tree) each
+            svc.search_many([query], k=k)
+        out_path = os.path.join(cfg.workdir, "trace_events.json")
+        with open(out_path, "w") as f:
+            json.dump(svc.tracer.chrome_trace(), f)
+        print(json.dumps({
+            "trace_file": out_path,
+            "traces": len(svc.tracer.traces()),
+            "spans": len(svc.tracer.chrome_trace()["traceEvents"]),
+            "slow_queries": len(svc.tracer.slow_queries()),
+            "slow_ms": cfg.obs.slow_ms}, sort_keys=True))
     elif args.command == "mine":
         from dnn_page_vectors_tpu.mine.ann import mine_hard_negatives
         store = VectorStore(store_dir)

@@ -241,6 +241,37 @@ class UpdatesConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability (utils/telemetry.py, utils/tracing.py,
+    docs/OBSERVABILITY.md): request-scoped tracing, the slow-query log,
+    and the metrics registry's rolling windows. The knob table in
+    docs/OBSERVABILITY.md is kept in lockstep with these fields by a
+    drift test (tests/test_telemetry.py)."""
+    # Request-scoped tracing on/off. Off, every span is a shared no-op
+    # object — instrumented paths pay one None-check.
+    enabled: bool = True
+    # Slow-query threshold in milliseconds: a finished request trace whose
+    # duration crosses this lands (as a full span tree) in the slow-query
+    # log. 0 captures EVERY request; negative disables the log.
+    slow_ms: float = -1.0
+    # Bounded slow-query log entries (oldest evicted first).
+    slow_log_size: int = 64
+    # Recent finished traces kept for `cli trace` export (ring buffer).
+    trace_buffer: int = 64
+    # Rolling window (seconds) behind the live qps / error-rate /
+    # cache-hit-rate / windowed-p99 numbers — "over the last N seconds",
+    # not since boot.
+    window_s: float = 10.0
+    # Bounded percentile reservoir size (Algorithm R): histograms and
+    # LatencyStats keep at most this many samples regardless of uptime;
+    # below it, percentiles are exact nearest-rank.
+    reservoir: int = 4096
+    # Lifecycle event ring size (view hot-swap, shard quarantine, drift
+    # rebuild, degraded/restored, checkpoint rollback).
+    events: int = 256
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultConfig:
     """Fault injection + transient-I/O retry policy (utils/faults.py,
     docs/ROBUSTNESS.md). Injection is OFF unless `plan` is non-empty; the
@@ -266,6 +297,7 @@ class Config:
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     updates: UpdatesConfig = dataclasses.field(default_factory=UpdatesConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     workdir: str = "/tmp/dnn_page_vectors_tpu"
 

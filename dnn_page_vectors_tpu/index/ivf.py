@@ -74,7 +74,7 @@ from dnn_page_vectors_tpu.index.pq import PQCodec, adc_topr, train_pq
 from dnn_page_vectors_tpu.infer.vector_store import crc_file
 from dnn_page_vectors_tpu.ops.topk import (
     chunked_topk, rerank_candidates, rerank_positions)
-from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils import faults, telemetry
 
 DIRNAME = "ivf"
 MANIFEST = "manifest.json"
@@ -432,6 +432,11 @@ class IVFIndex:
                             pq_iters=pq_cfg.get("iters", 8),
                             opq_iters=pq_cfg.get("opq_iters", 3))
             faults.count("index_full_rebuilds")
+            # lifecycle event (docs/OBSERVABILITY.md): a full rebuild is
+            # the expensive transition operators watch for
+            telemetry.default_registry().event(
+                "ivf_rebuild", {"reason": reason[:200],
+                                "nlist": idx.nlist})
             return idx, {"action": "rebuild", "reason": reason,
                          "seconds": round(time.perf_counter() - t0, 3)}
 
@@ -773,6 +778,12 @@ class IVFIndex:
                  "candidates_reranked":
                      int(self.list_sizes[sel].sum()),
                  "gather_bytes": 0}
+        # index-level instruments (docs/OBSERVABILITY.md): windowed search
+        # rate + probe volume regardless of which service routed here
+        reg = telemetry.default_registry()
+        reg.counter("ivf.searches",
+                    window_s=telemetry.DEFAULT_WINDOW_S).inc(nq)
+        reg.counter("ivf.lists_scanned").inc(nq * nprobe)
         if self.pq is not None:
             return self._search_adc(qvecs, sel, k, block, rerank,
                                     out_s, out_i, stats)

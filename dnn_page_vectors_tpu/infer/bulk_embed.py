@@ -368,6 +368,14 @@ class BulkEmbedder:
         write_pending = (self.cfg.eval.writeback_depth if write_pending is None
                          else write_pending)
         prof = PipelineProfiler() if profiler is None else profiler
+        # embed-sweep throughput as registry instruments (docs/
+        # OBSERVABILITY.md): the windowed pages counter answers "what is
+        # the rate RIGHT NOW" mid-sweep, the end-of-job gauge mirrors the
+        # metrics line
+        from dnn_page_vectors_tpu.utils import telemetry
+        _reg = telemetry.default_registry()
+        _m_pages = _reg.counter("embed.pages",
+                                window_s=telemetry.DEFAULT_WINDOW_S)
         t0 = time.perf_counter()
         pages = 0
         writer = _ShardWriter(store, q8, max_pending=write_pending,
@@ -418,7 +426,9 @@ class BulkEmbedder:
                             vecs = np.asarray(p[1])
                             vec_acc.append(vecs.reshape(-1, vecs.shape[-1]))
                     ids_acc.append(ids)
-                    pages += int((ids >= 0).sum())
+                    real = int((ids >= 0).sum())
+                    pages += real
+                    _m_pages.inc(real)
 
                 for batch in prefetch_to_device(batches, sharding=sharding,
                                                 profiler=prof):
@@ -439,6 +449,8 @@ class BulkEmbedder:
             writer.close(raise_error=False)  # primary exception wins
             raise
         writer.close()   # join + re-raise any write failure
+        _reg.gauge("embed.pages_per_sec_per_chip").set(
+            pages / max(time.perf_counter() - t0, 1e-9) / n_dev)
         if log:
             rec = {"bulk_embed_pages": pages, **prof.summary()}
             fc = faults.counters()

@@ -63,6 +63,20 @@ whose only drift is a few new tombstones is reused with the dead rows
 masked in its id table, and restages compacted once the staged block's
 dead density crosses the threshold (`restage_skipped`/`restage_forced`).
 
+Observability (docs/OBSERVABILITY.md): every search/search_many call runs
+under a request-scoped trace (utils/tracing.py) — a span tree covering
+queue_wait (through the micro-batcher's thread hop, handed off explicitly)
+-> tokenize/encode (cache hits annotated) -> topk (ANN lists_scanned /
+gather_bytes / rows_reranked as span attributes) -> merge -> format; a
+request slower than `obs.slow_ms` lands, tree and all, in the bounded
+slow-query log, and `cli trace` exports the recent ring as Chrome/Perfetto
+trace_event JSON. Serving counters live in a per-service MetricsRegistry
+(utils/telemetry.py): windowed qps/error-rate/cache-hit/p99 over the last
+`obs.window_s` seconds next to the since-boot totals, lifecycle events
+(view hot-swap, shard quarantine, drift rebuild, degraded/restored) with
+trace-id correlation, and a Prometheus-text + JSON snapshot exposition
+(`cli serve-metrics`, the `:metrics` control line).
+
 Degradation (docs/ROBUSTNESS.md): a shard that FAILS to stage — an I/O
 fault during the device_put, a checksum mismatch, or the HBM budget
 overrunning mid-stage — does not kill the service. Checksum failures are
@@ -76,6 +90,7 @@ log, so a half-staged service is visible, not silent.
 """
 from __future__ import annotations
 
+import contextlib
 import queue as queue_mod
 import threading
 import time
@@ -91,6 +106,8 @@ from dnn_page_vectors_tpu.ops.topk import (
     merge_shard_topk, sharded_topk, stage_shard, topk_over_store)
 from dnn_page_vectors_tpu.utils import faults
 from dnn_page_vectors_tpu.utils.profiling import LatencyStats, PipelineProfiler
+from dnn_page_vectors_tpu.utils.telemetry import MetricsRegistry
+from dnn_page_vectors_tpu.utils.tracing import Tracer
 
 
 class _MicroBatcher:
@@ -126,7 +143,11 @@ class _MicroBatcher:
 
     def submit(self, query: str, k: Optional[int]) -> Future:
         fut: Future = Future()
-        self._q.put((query, k, fut, time.perf_counter()))
+        # capture the caller's active span HERE: the dispatcher runs on
+        # another thread where the contextvar chain breaks, so the trace
+        # context rides the queue explicitly (docs/OBSERVABILITY.md)
+        ctx = self._svc.tracer.current()
+        self._q.put((query, k, fut, time.perf_counter(), ctx))
         return fut
 
     def _run(self) -> None:
@@ -150,24 +171,42 @@ class _MicroBatcher:
             self._dispatch(batch)
 
     def _dispatch(self, batch) -> None:
+        tracer = self._svc.tracer
         now = time.perf_counter()
-        for _, _, _, t0 in batch:
+        for _, _, _, t0, ctx in batch:
             self._svc.profiler.add("queue_wait", now - t0)
+            if ctx is not None:
+                # finished child stamped onto the REQUEST's tree: how long
+                # this request sat in the queue before its dispatch
+                ctx.child("queue_wait", now - t0, t0=t0)
         self.batch_sizes.append(len(batch))
         by_k: Dict[Optional[int], list] = {}
-        for query, k, fut, _ in batch:
-            by_k.setdefault(k, []).append((query, fut))
+        for query, k, fut, _, ctx in batch:
+            by_k.setdefault(k, []).append((query, fut, ctx))
         for k, items in by_k.items():
             try:
-                res = self._svc.search_many([q for q, _ in items], k=k)
+                # the coalesced dispatch traces ONCE under a detached root
+                # (record=False: it only exists grafted into request
+                # trees), then every request adopts the finished subtree —
+                # one measurement, N complete span trees
+                with tracer.trace("dispatch", record=False,
+                                  batch_size=len(items)) as dsp:
+                    res = self._svc.search_many(
+                        [q for q, _, _ in items], k=k, _record=False)
             except BaseException:  # noqa: BLE001 — isolate per request
-                for q, fut in items:
+                for q, fut, ctx in items:
                     try:
-                        fut.set_result(self._svc.search_many([q], k=k)[0])
+                        # per-request retry: re-activate the caller's span
+                        # on THIS thread so retry spans nest under it
+                        with tracer.use(ctx):
+                            fut.set_result(self._svc.search_many(
+                                [q], k=k, _record=False)[0])
                     except BaseException as e:  # noqa: BLE001
                         fut.set_exception(e)
                 continue
-            for (_, fut), r in zip(items, res):
+            for (_, fut, ctx), r in zip(items, res):
+                if ctx is not None:
+                    ctx.adopt(dsp)
                 fut.set_result(r)
 
     def close(self) -> None:
@@ -212,7 +251,8 @@ class SearchService:
     def __init__(self, cfg, embedder: BulkEmbedder, corpus,
                  store: VectorStore, preload_hbm_gb: float = 4.0,
                  snippet_chars: int = 160, query_batch: Optional[int] = None,
-                 log=None, profiler: Optional[PipelineProfiler] = None):
+                 log=None, profiler: Optional[PipelineProfiler] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.cfg = cfg
         self.embedder = embedder
         self.corpus = corpus
@@ -224,6 +264,47 @@ class SearchService:
         # merge/format) — one shared instance; the batcher and concurrent
         # callers all add into it
         self.profiler = profiler or PipelineProfiler()
+        # -- telemetry (docs/OBSERVABILITY.md) ----------------------------
+        # One registry per service (counters must not mix across services)
+        # holding every serving instrument; request-scoped tracing follows
+        # the obs.* section. PipelineProfiler stays the cumulative stage
+        # accountant; the registry adds what it can't say: live windowed
+        # rates (qps/error/cache-hit over obs.window_s), bounded latency
+        # percentiles, and the lifecycle event channel.
+        obs = getattr(cfg, "obs", None)
+        window_s = getattr(obs, "window_s", 10.0) if obs is not None else 10.0
+        reservoir = getattr(obs, "reservoir", 4096) if obs is not None \
+            else 4096
+        self._window_s = window_s
+        self.registry = registry or MetricsRegistry(
+            events=getattr(obs, "events", 256) if obs is not None else 256)
+        self.tracer = Tracer(
+            enabled=getattr(obs, "enabled", True) if obs is not None
+            else True,
+            slow_ms=getattr(obs, "slow_ms", -1.0) if obs is not None
+            else -1.0,
+            slow_log_size=getattr(obs, "slow_log_size", 64)
+            if obs is not None else 64,
+            buffer=getattr(obs, "trace_buffer", 64) if obs is not None
+            else 64)
+        reg = self.registry
+        self._m_requests = reg.counter("serve.requests", window_s=window_s)
+        self._m_errors = reg.counter("serve.errors", window_s=window_s)
+        self._m_latency = reg.histogram("serve.latency_ms",
+                                        window_s=window_s, cap=reservoir)
+        self._m_cache_hits = reg.counter("serve.cache_hits",
+                                         window_s=window_s)
+        self._m_cache_misses = reg.counter("serve.cache_misses",
+                                           window_s=window_s)
+        self._m_ann_lists = reg.counter("serve.ann_lists_scanned")
+        self._m_ann_reranked = reg.counter("serve.ann_candidates_reranked")
+        self._m_ann_fallbacks = reg.counter("serve.ann_fallbacks")
+        self._m_ann_gather = reg.counter("serve.ann_gather_bytes")
+        self._m_refreshes = reg.counter("serve.refreshes")
+        self._m_incremental = reg.counter("serve.incremental_updates")
+        self._m_rebuilds = reg.counter("serve.full_rebuilds")
+        self._m_restage_skipped = reg.counter("serve.restage_skipped")
+        self._m_restage_forced = reg.counter("serve.restage_forced")
         # LRU query-embedding cache: normalized text + the store's model
         # step -> host fp32 query vector. Step in the KEY means a store
         # re-stamp (ensure_model_step) invalidates without a flush.
@@ -232,8 +313,6 @@ class SearchService:
         self._cache_cap = (serve_cfg.query_cache_size
                            if serve_cfg is not None else 0)
         self._cache_lock = threading.Lock()
-        self.cache_hits = 0
-        self.cache_misses = 0
         # IVF ANN routing (docs/ANN.md): serve.index="ivf" tries the
         # inverted-file index; every request re-checks it against the
         # store's stamp and falls back to the exact path (counted) when
@@ -260,20 +339,6 @@ class SearchService:
         self._restage_density = (
             getattr(upd_cfg, "restage_tombstone_density", 0.05)
             if upd_cfg is not None else 0.05)
-        self.ann_lists_scanned = 0
-        self.ann_candidates_reranked = 0
-        self.ann_fallbacks = 0
-        self.ann_gather_bytes = 0
-        # live-update counters (docs/UPDATES.md)
-        self.refreshes = 0
-        self.incremental_updates = 0
-        self.full_rebuilds = 0
-        # tombstone-aware restage policy counters (docs/UPDATES.md):
-        # skipped = staged shard reused with its new dead rows masked in
-        # the id table; forced = dead density crossed the threshold and
-        # the shard restaged compacted
-        self.restage_skipped = 0
-        self.restage_forced = 0
         self._batcher: Optional[_MicroBatcher] = None
         self._batch_sizes: List[int] = []   # telemetry after close()
         self._log = log
@@ -292,6 +357,10 @@ class SearchService:
         self._preload_gb = preload_hbm_gb
         self._refresh_lock = threading.Lock()   # one refresh at a time
         self._view = self._build_view(store)
+        self.registry.gauge("serve.degraded").set(
+            1.0 if self.degraded else 0.0)
+        self.registry.gauge("serve.store_generation").set(
+            self._view.generation)
         if log is not None:
             view = self._view
             log.write({
@@ -329,6 +398,70 @@ class SearchService:
     def _index_error(self) -> Optional[str]:
         return self._view.index_error
 
+    # serving counters are registry instruments (docs/OBSERVABILITY.md);
+    # these read-only windows keep the pre-registry attribute surface that
+    # tests, bench, and operator scripts already use
+    @property
+    def cache_hits(self) -> int:
+        return self._m_cache_hits.value
+
+    @property
+    def cache_misses(self) -> int:
+        return self._m_cache_misses.value
+
+    @property
+    def ann_lists_scanned(self) -> int:
+        return self._m_ann_lists.value
+
+    @property
+    def ann_candidates_reranked(self) -> int:
+        return self._m_ann_reranked.value
+
+    @property
+    def ann_fallbacks(self) -> int:
+        return self._m_ann_fallbacks.value
+
+    @property
+    def ann_gather_bytes(self) -> int:
+        return self._m_ann_gather.value
+
+    @property
+    def refreshes(self) -> int:
+        return self._m_refreshes.value
+
+    @property
+    def incremental_updates(self) -> int:
+        return self._m_incremental.value
+
+    @property
+    def full_rebuilds(self) -> int:
+        return self._m_rebuilds.value
+
+    # tombstone-aware restage policy counters (docs/UPDATES.md):
+    # skipped = staged shard reused with its new dead rows masked in
+    # the id table; forced = dead density crossed the threshold and
+    # the shard restaged compacted
+    @property
+    def restage_skipped(self) -> int:
+        return self._m_restage_skipped.value
+
+    @property
+    def restage_forced(self) -> int:
+        return self._m_restage_forced.value
+
+    @contextlib.contextmanager
+    def _stage(self, name: str, **attrs):
+        """One serving stage, observed twice from one clock: cumulative
+        seconds into the PipelineProfiler (the aggregate view) and a span
+        on the active request trace (the per-request view). Yields the
+        span so call sites can attach attributes (ANN stats, cache hits)."""
+        t0 = time.perf_counter()
+        with self.tracer.span(name, **attrs) as sp:
+            try:
+                yield sp
+            finally:
+                self.profiler.add(name, time.perf_counter() - t0)
+
     def _count_fault(self, name: str) -> None:
         self.fault_counters[name] = self.fault_counters.get(name, 0) + 1
         faults.count(name)
@@ -360,7 +493,7 @@ class SearchService:
             t_swap = time.perf_counter()
             self._view = view        # THE swap: one reference assignment
             self.store = new_store
-            self.refreshes += 1
+            self._m_refreshes.inc()
         swap_ms = (time.perf_counter() - t_swap) * 1000.0
         info = {
             "store_generation": view.generation,
@@ -379,6 +512,20 @@ class SearchService:
             info["index_update"] = view.index_info
         if view.index_error is not None:
             info["index_error"] = view.index_error
+        # lifecycle event (docs/OBSERVABILITY.md): the hot-swap is the
+        # transition dashboards alert on; trace-id correlation ties it to
+        # the request that observed it when refresh runs under a trace
+        cur = self.tracer.current()
+        self.registry.event("view_swap", {
+            "store_generation": view.generation,
+            "new_docs": info["new_docs"],
+            "swap_ms": info["swap_ms"],
+            "index_error": view.index_error,
+        }, trace_id=cur.trace_id if cur is not None else None)
+        self.registry.gauge("serve.store_generation").set(view.generation)
+        if view.index is not None:
+            self.registry.gauge("serve.index_generation").set(
+                view.index.index_generation)
         if self._log is not None:
             self._log.write({"serve_refresh": self.refreshes, **info})
         return info
@@ -409,6 +556,12 @@ class SearchService:
                 view.shards = None    # stream instead; handles empty stores
         if self._serve_index == "ivf":
             self._attach_index(view, update_index)
+            if (reuse is not None and reuse.index_error is not None
+                    and view.index is not None):
+                # a degraded-to-exact view healed across the refresh
+                self.registry.event("index_restored", {
+                    "was": reuse.index_error[:200],
+                    "index_generation": view.index.index_generation})
         return view
 
     # -- IVF ANN index (docs/ANN.md, docs/UPDATES.md) ----------------------
@@ -424,9 +577,12 @@ class SearchService:
                     init=getattr(serve_cfg, "kmeans_init", "kmeans++"))
                 action = view.index_info.get("action")
                 if action == "incremental":
-                    self.incremental_updates += 1
+                    self._m_incremental.inc()
                 elif action == "rebuild":
-                    self.full_rebuilds += 1
+                    self._m_rebuilds.inc()
+                    self.registry.event("drift_rebuild", {
+                        "drift": view.index_info.get("drift"),
+                        "nlist": view.index_info.get("nlist")})
             else:
                 view.index = IVFIndex.open(view.store)
             view.index_error = None
@@ -449,6 +605,8 @@ class SearchService:
         except IndexUnavailable as e:
             view.index = None
             view.index_error = str(e)
+            self.registry.event("index_degraded",
+                                {"reason": str(e)[:200], "mode": "exact"})
             faults.warn(f"IVF index unavailable ({e}); serving the exact "
                         "path per request")
         except Exception as e:  # noqa: BLE001 — e.g. a posting-append
@@ -458,6 +616,8 @@ class SearchService:
             view.index = None
             view.index_error = f"{type(e).__name__}: {e}"
             self._count_fault("serve_index_update_failures")
+            self.registry.event("index_degraded", {
+                "reason": view.index_error[:200], "mode": "exact"})
             faults.warn(f"IVF index update failed ({view.index_error}); "
                         "serving the exact path until a rebuild")
 
@@ -470,22 +630,33 @@ class SearchService:
         idx = view.index
         if idx is None or idx.model_step != view.store.model_step:
             return None
-        prof = self.profiler
         try:
-            with prof.stage("topk"):
+            with self._stage("topk") as sp:
                 scores, ids, st = idx.search(
                     qv[:n], k=k, nprobe=self._nprobe,
                     rerank=self._pq_rerank or None)
+                # the ANN cost triple ON the request's span (why THIS
+                # query was slow): lists probed, payload bytes gathered,
+                # rows exact-reranked
+                sp.set_attrs(
+                    lists_scanned=st.get("lists_scanned", 0),
+                    gather_bytes=st.get("gather_bytes", 0),
+                    rows_reranked=st.get("candidates_reranked", 0))
         except Exception as e:  # noqa: BLE001 — any index failure degrades
             view.index = None
             view.index_error = f"{type(e).__name__}: {e}"
+            cur = self.tracer.current()
+            self.registry.event(
+                "index_degraded",
+                {"reason": view.index_error[:200], "mode": "exact"},
+                trace_id=cur.trace_id if cur is not None else None)
             faults.warn(f"IVF search failed ({view.index_error}); "
                         "falling back to exact search")
             return None
-        self.ann_lists_scanned += st.get("lists_scanned", 0)
-        self.ann_candidates_reranked += st.get("candidates_reranked", 0)
-        self.ann_gather_bytes += st.get("gather_bytes", 0)
-        with prof.stage("format"):
+        self._m_ann_lists.inc(st.get("lists_scanned", 0))
+        self._m_ann_reranked.inc(st.get("candidates_reranked", 0))
+        self._m_ann_gather.inc(st.get("gather_bytes", 0))
+        with self._stage("format"):
             return [self._format(scores[i], ids[i]) for i in range(n)]
 
     def _stage_view(self, view: "_ServeView", rows: int,
@@ -544,9 +715,9 @@ class SearchService:
                         staged.append((masked, old_n, pages, scl))
                         keys.append(key)
                         used += per_shard
-                        self.restage_skipped += 1
+                        self._m_restage_skipped.inc()
                         continue
-                    self.restage_forced += 1   # falls through: restage
+                    self._m_restage_forced.inc()   # falls through: restage
                 plan.check("hbm_stage")
                 err = store.entry_error(entry)
                 if err is not None:
@@ -557,6 +728,9 @@ class SearchService:
                     store.quarantine(entry, err)
                     self._count_fault("serve_quarantined_shards")
                     self.degraded = True
+                    self.registry.gauge("serve.degraded").set(1.0)
+                    self.registry.event("shard_quarantine", {
+                        "shard": entry["index"], "error": str(err)[:200]})
                     continue
                 if used + per_shard > budget_bytes:
                     raise MemoryError(
@@ -585,6 +759,11 @@ class SearchService:
                 view.stream_entries.append(entry)
                 self.degraded = True
                 self._count_fault("serve_stage_faults")
+                self.registry.gauge("serve.degraded").set(1.0)
+                self.registry.event("degraded", {
+                    "shard": entry["index"],
+                    "reason": f"{type(e).__name__}: {e}"[:200],
+                    "mode": "streaming"})
                 faults.warn(
                     f"HBM staging failed for shard {entry['index']} "
                     f"({type(e).__name__}: {e}); serving it via the "
@@ -647,7 +826,6 @@ class SearchService:
         query_batch buckets). Host-side vectors cost the queries one device
         round trip per bucket — amortized over the coalesced batch, and the
         price of cache hits skipping the encode dispatch entirely."""
-        prof = self.profiler
         step = self.store.model_step
         keys = [(step, self._normalize(q)) for q in queries]
         out = np.zeros((len(queries), self.store.dim), np.float32)
@@ -659,12 +837,19 @@ class SearchService:
                     if vec is not None:
                         self._cache.move_to_end(key)
                         out[i] = vec
-                        self.cache_hits += 1
                     else:
                         miss.append(i)
-                        self.cache_misses += 1
+            self._m_cache_hits.inc(len(queries) - len(miss))
+            self._m_cache_misses.inc(len(miss))
         else:
             miss = list(range(len(queries)))
+        # cache-hit annotation on the request trace: an all-hit request
+        # legitimately has NO tokenize/encode spans — the annotation says
+        # why, instead of the trace just looking truncated
+        cur = self.tracer.current()
+        if cur is not None:
+            cur.set_attrs(cache_hits=len(queries) - len(miss),
+                          cache_misses=len(miss))
         if not miss:
             return out
         # intra-batch dedup: a coalesced batch of head-skewed traffic
@@ -684,13 +869,13 @@ class SearchService:
         B = self.query_batch
         for s in range(0, len(uniq), B):
             grp = uniq[s: s + B]
-            with prof.stage("tokenize"):
+            with self._stage("tokenize", queries=len(grp)):
                 enc = tok.encode_batch([queries[i] for i in grp])
             pad = B - enc.shape[0]
             if pad:
                 enc = np.concatenate(
                     [enc, np.zeros((pad,) + enc.shape[1:], enc.dtype)])
-            with prof.stage("encode"):
+            with self._stage("encode", queries=len(grp)):
                 vecs = np.asarray(
                     self.embedder._encode_query(self.embedder.params,
                                                 self.embedder._put(enc)),
@@ -763,6 +948,7 @@ class SearchService:
             # tombstone-aware restage policy (docs/UPDATES.md)
             "restage_skipped": self.restage_skipped,
             "restage_forced": self.restage_forced,
+            **self._window_metrics(),
             **self.profiler.summary(prefix="serve_stage_"),
         }
         sizes = (self._batcher.batch_sizes if self._batcher is not None
@@ -793,6 +979,39 @@ class SearchService:
             rec["fault_counters"] = faults.counters()
         return rec
 
+    def _window_metrics(self) -> Dict[str, float]:
+        """The live windowed view (docs/OBSERVABILITY.md): rates and tail
+        latency over the last obs.window_s seconds, not since boot — the
+        "qps @ p99 < X ms" SLO pair reads straight off these."""
+        req_w = self._m_requests.window_count()
+        err_w = self._m_errors.window_count()
+        hit_w = self._m_cache_hits.window_count()
+        miss_w = self._m_cache_misses.window_count()
+        lat = self._m_latency
+        return {
+            "serve_window_s": self._window_s,
+            "serve_window_qps": round(self._m_requests.rate(), 3),
+            "serve_window_error_rate": round(
+                err_w / (req_w + err_w), 4) if (req_w + err_w) else 0.0,
+            "serve_window_cache_hit_rate": round(
+                hit_w / (hit_w + miss_w), 4) if (hit_w + miss_w) else 0.0,
+            "serve_window_p50_ms": round(lat.window_percentile(50), 3),
+            "serve_window_p99_ms": round(lat.window_percentile(99), 3),
+        }
+
+    # -- exposition (docs/OBSERVABILITY.md) --------------------------------
+    def metrics_snapshot(self) -> Dict:
+        """JSON snapshot endpoint: the flat metrics() record plus the full
+        registry view (typed instruments, windowed stats, the lifecycle
+        event ring). Everything json-serializable — served by
+        `cli serve-metrics --json` and the `:metrics` control line."""
+        return {"metrics": self.metrics(), **self.registry.snapshot()}
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition of the service registry — served by
+        `cli serve-metrics`; one scrape of this is the dashboard feed."""
+        return self.registry.prometheus_text()
+
     # -- search ------------------------------------------------------------
     def warmup(self, k: Optional[int] = None, timing_iters: int = 3) -> None:
         """Compile the encode + top-k programs before the first query, then
@@ -819,20 +1038,40 @@ class SearchService:
         """One query -> top-k results. With the micro-batcher running
         (start_batcher), the call enqueues and blocks on its future —
         concurrent callers share dispatches; otherwise it is a direct
-        single-query search_many."""
+        single-query search_many. Either way the request is traced
+        (obs.enabled) and lands in the windowed latency/qps instruments:
+        the batched path's trace follows the request THROUGH the
+        dispatcher thread (queue_wait + the adopted shared dispatch)."""
         b = self._batcher
-        if b is not None:
-            return b.submit(query, k).result()
-        return self.search_many([query], k=k)[0]
+        if b is None:
+            return self.search_many([query], k=k)[0]
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.trace("search",
+                                   k=k or self.cfg.eval.recall_k,
+                                   query=self._normalize(query)[:80]):
+                res = b.submit(query, k).result()
+        except BaseException:
+            self._m_errors.inc()
+            raise
+        self._m_requests.inc()
+        self._m_latency.observe((time.perf_counter() - t0) * 1000.0)
+        return res
 
-    def search_many(self, queries: Sequence[str],
-                    k: Optional[int] = None) -> List[List[Dict]]:
+    def search_many(self, queries: Sequence[str], k: Optional[int] = None,
+                    *, _record: bool = True) -> List[List[Dict]]:
         """Vectorized multi-query search: one result list per query, in
         order. Queries fill the compiled `query_batch` bucket (larger lists
         tile over full buckets — one compiled program regardless of count);
         per-shard top-k and the cross-shard merge run once per bucket, and
         on a degraded service the failed shards' disk sweep folds in once
-        per bucket too."""
+        per bucket too.
+
+        Telemetry: the call runs under a request trace (a fresh root for
+        direct callers, a child span inside a batcher dispatch) and — for
+        direct callers (`_record`) — counts every query into the windowed
+        request/error/latency instruments; the batcher records per-request
+        numbers itself so coalesced queries are never double-counted."""
         k = k or self.cfg.eval.recall_k
         n = len(queries)
         if n == 0:
@@ -842,14 +1081,29 @@ class SearchService:
         # dispatch finishes on the view it captured, the next one sees the
         # new view
         view = self._view
-        qv = self._embed_queries_cached(list(queries))
-        prof = self.profiler
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.root_or_span("search_many", n_queries=n, k=k):
+                out = self._search_view(view, list(queries), n, k)
+        except BaseException:
+            if _record:
+                self._m_errors.inc(n)
+            raise
+        if _record:
+            self._m_requests.inc(n)
+            self._m_latency.observe((time.perf_counter() - t0) * 1000.0,
+                                    n=n)
+        return out
+
+    def _search_view(self, view: "_ServeView", queries: List[str],
+                     n: int, k: int) -> List[List[Dict]]:
+        qv = self._embed_queries_cached(queries)
         if self._serve_index == "ivf":
             res = self._search_ann(view, qv, n, k)
             if res is not None:
                 return res
             # exact path serves this request; visible in metrics + counters
-            self.ann_fallbacks += n
+            self._m_ann_fallbacks.inc(n)
             faults.count("serve_ann_fallbacks", n)
         B = self.query_batch
         if view.shards is None:
@@ -864,11 +1118,11 @@ class SearchService:
             if pad:
                 qv = np.concatenate(
                     [qv, np.zeros((pad, qv.shape[1]), np.float32)])
-            with prof.stage("topk"):
+            with self._stage("topk", path="streaming"):
                 scores, ids = topk_over_store(qv, view.store,
                                               self.embedder.mesh, k=k,
                                               query_batch=B)
-            with prof.stage("format"):
+            with self._stage("format"):
                 return [self._format(scores[i], ids[i]) for i in range(n)]
         # Two passes over the buckets: dispatch them ALL first (the merge
         # output stays on device — JAX's async queue runs bucket i+1's
@@ -896,14 +1150,13 @@ class SearchService:
         local PCIe.)"""
         import jax.numpy as jnp
 
-        prof = self.profiler
         nreal = qblock.shape[0]
         B = self.query_batch
         if nreal < B:
             qblock = np.concatenate(
                 [qblock, np.zeros((B - nreal, qblock.shape[1]), np.float32)])
         q = jnp.asarray(qblock, jnp.float32)
-        with prof.stage("topk"):
+        with self._stage("topk", shards=len(view.shards)):
             cands = [
                 sharded_topk(q, pages, self.embedder.mesh, k=k, valid=n,
                              scales=scl)
@@ -913,15 +1166,14 @@ class SearchService:
 
     def _collect_bucket(self, view: "_ServeView", nreal: int, q, packed,
                         k: int) -> List[List[Dict]]:
-        prof = self.profiler
-        with prof.stage("merge"):
+        with self._stage("merge"):
             packed = np.asarray(packed)                # the one transfer
         top_s = np.ascontiguousarray(packed[:, :k]).view(np.float32)
         top_i = packed[:, k:]
         pids = np.where(top_i >= 0,
                         view.pid_table[np.clip(top_i, 0, None)], -1)
         if not view.stream_entries:
-            with prof.stage("format"):
+            with self._stage("format"):
                 return [self._format(top_s[i], pids[i])
                         for i in range(nreal)]
         # degraded tail: shards that failed to stage are re-read from disk
@@ -938,7 +1190,8 @@ class SearchService:
                 ids, vecs, scl = view.store._load_entry(entry, raw=True)
                 yield np.asarray(ids, np.int64), np.asarray(vecs), scl
 
-        with prof.stage("topk"):
+        with self._stage("topk", path="degraded_tail",
+                         shards=len(view.stream_entries)):
             for ids, vecs, scl in read_ahead(_load_tail(), depth=1):
                 nrows = vecs.shape[0]
                 if nrows == 0:
@@ -949,7 +1202,7 @@ class SearchService:
                 best_s, best_i = merge_shard_topk(
                     q, pages, ids, nrows, self.embedder.mesh, k,
                     best_s, best_i, scales=scales)
-        with prof.stage("format"):
+        with self._stage("format"):
             return [self._format(best_s[i], best_i[i]) for i in range(nreal)]
 
     def _format(self, scores, ids) -> List[Dict]:

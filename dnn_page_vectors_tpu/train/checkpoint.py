@@ -24,7 +24,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils import faults, telemetry
 
 
 class CheckpointManager:
@@ -103,6 +103,12 @@ class CheckpointManager:
                 continue
             if errors:
                 faults.count("ckpt_rollback")
+                # lifecycle event (docs/OBSERVABILITY.md): a rollback means
+                # newer training work was silently lost — dashboards alert
+                # on this transition, not just a counter
+                telemetry.default_registry().event("ckpt_rollback", {
+                    "restored_step": s, "skipped": len(errors),
+                    "directory": self.directory})
                 faults.warn(
                     f"checkpoint rollback in {self.directory}: restored "
                     f"step {s}; skipped corrupt newer checkpoint(s): "

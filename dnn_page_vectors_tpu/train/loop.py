@@ -33,7 +33,7 @@ from dnn_page_vectors_tpu.parallel.sharding import (
     batch_sharding, param_shardings, put_global, replicated, shard_params,
     stacked_batch_sharding)
 from dnn_page_vectors_tpu.train.optimizer import make_optimizer
-from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils import faults, telemetry
 from dnn_page_vectors_tpu.utils.logging import MetricsLogger
 from dnn_page_vectors_tpu.utils.profiling import PipelineProfiler
 
@@ -267,7 +267,10 @@ class Trainer:
         else:
             step_fn = self.compiled_step(state)
         base_rng = self.base_rng()
-        log = log or MetricsLogger(self.workdir)
+        # default logger mirrors every numeric scalar into the process
+        # registry (docs/OBSERVABILITY.md) — jsonl shape unchanged
+        log = log or MetricsLogger(self.workdir,
+                                   registry=telemetry.default_registry())
         pages_per_step = cfg.train.batch_size
         n_dev = self.mesh.devices.size
         # MFU next to pages/sec/chip so every logged rate is interpretable
@@ -278,6 +281,12 @@ class Trainer:
         flops_pair = train_flops_per_pair(cfg, cfg.train.batch_size)
         start_step = int(state.step)
         prof = PipelineProfiler() if profiler is None else profiler
+        # train-loop throughput as registry instruments (docs/
+        # OBSERVABILITY.md): a windowed steps counter gives live steps/sec
+        # mid-run; the gauges mirror the numbers the metrics line reports
+        _reg = telemetry.default_registry()
+        _m_steps = _reg.counter("train.steps",
+                                window_s=telemetry.DEFAULT_WINDOW_S)
         it = (self.stacked_batches(start_step=start_step, k=scan_k,
                                    profiler=prof)
               if scan_k > 1 else self.batches(start_step=start_step,
@@ -288,6 +297,7 @@ class Trainer:
             batch = next(it)
             with prof.stage("compute"):   # dispatch; async past the first
                 state, metrics = step_fn(state, batch, base_rng)
+            _m_steps.inc(scan_k)
             i = (c + 1) * scan_k         # steps completed this call
             if i % cfg.train.log_every == 0 or i == steps:
                 metrics = {k: float(v) for k, v in metrics.items()}
@@ -297,8 +307,10 @@ class Trainer:
                 done = int(state.step) - start_step
                 pps_chip = done * pages_per_step / dt / n_dev
                 metrics["pages_per_sec_per_chip"] = pps_chip
+                _reg.gauge("train.pages_per_sec_per_chip").set(pps_chip)
                 if peak:
                     metrics["mfu"] = pps_chip * flops_pair / peak
+                    _reg.gauge("train.mfu").set(metrics["mfu"])
                 try:  # HBM headroom next to throughput (memory_stats()
                       # is None on CPU and on the tunneled axon backend)
                     stats = self.mesh.devices.flat[0].memory_stats()

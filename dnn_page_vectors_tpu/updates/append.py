@@ -24,7 +24,7 @@ import numpy as np
 
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
 from dnn_page_vectors_tpu.infer.vector_store import VectorStore
-from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils import faults, telemetry
 
 
 def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
@@ -100,6 +100,18 @@ def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
         "seconds": round(dt, 3),
         "append_docs_per_s": round(all_ids.shape[0] / max(dt, 1e-9), 2),
     }
+    # registry instruments + lifecycle event (docs/OBSERVABILITY.md): the
+    # update counters feed the same exposition as serving, and the event
+    # channel records the generation transition itself
+    reg = telemetry.default_registry()
+    reg.counter("updates.docs_appended").inc(len(new_ids))
+    reg.counter("updates.docs_updated").inc(len(update_ids))
+    reg.counter("updates.docs_tombstoned").inc(stats["tombstoned"])
+    reg.counter("updates.generations").inc()
+    reg.gauge("updates.append_docs_per_s").set(stats["append_docs_per_s"])
+    reg.event("generation_append", {
+        "generation": man["gen"], "appended": len(new_ids),
+        "updated": len(update_ids), "tombstoned": stats["tombstoned"]})
     if log is not None:
         rec = {"append_generation": man["gen"], **stats}
         fc = faults.counters()

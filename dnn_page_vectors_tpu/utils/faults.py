@@ -252,6 +252,12 @@ _COUNTER_LOCK = threading.Lock()
 def count(event: str, n: int = 1) -> None:
     with _COUNTER_LOCK:
         _COUNTERS[event] = _COUNTERS.get(event, 0) + n
+    # mirror into the process-wide metrics registry (docs/OBSERVABILITY.md)
+    # so fault/recovery activity shows up in the same exposition as every
+    # other instrument — `counters()` stays the dict the metrics lines and
+    # tests read
+    from dnn_page_vectors_tpu.utils import telemetry
+    telemetry.default_registry().counter(f"fault.{event}").inc(n)
 
 
 def counters() -> Dict[str, int]:

@@ -1,7 +1,19 @@
-"""Metrics/observability (SURVEY.md §3 #26, §5.5).
+"""Metrics/observability (SURVEY.md §3 #26, §5.5; docs/OBSERVABILITY.md).
 
 Emits the two baseline metrics verbatim — pages/sec/chip and Recall@10
 (BASELINE.json:2) — as jsonl under workdir, mirrored to stdout.
+
+Re-based on the metrics registry (utils/telemetry.py): when a `registry`
+is attached, every numeric scalar written also lands as a registry gauge
+under the same key, so the jsonl line, the Prometheus exposition, and the
+JSON snapshot all read the SAME number from the same write — the jsonl
+output shape ({"ts": ..., sorted keys}) is unchanged.
+
+Lifecycle: a context manager (`with MetricsLogger(...) as log:`), and
+`write()` after `close()` is tolerated — the file handle is gone, so the
+line goes to stderr/registry only instead of raising (serve.py flushes
+final metrics through close(); a late writer must not take the service
+down over a log line).
 """
 from __future__ import annotations
 
@@ -14,9 +26,11 @@ from typing import Any, Dict, Optional
 
 class MetricsLogger:
     def __init__(self, workdir: Optional[str] = None, name: str = "metrics",
-                 echo: bool = True):
+                 echo: bool = True, registry=None):
         self.echo = echo
+        self.registry = registry
         self._f = None
+        self._closed = False
         if workdir:
             os.makedirs(workdir, exist_ok=True)
             self._f = open(os.path.join(workdir, f"{name}.jsonl"), "a")
@@ -25,13 +39,29 @@ class MetricsLogger:
         rec = {"ts": time.time(), **{
             k: (float(v) if hasattr(v, "item") else v)
             for k, v in metrics.items()}}
+        if self.registry is not None:
+            for k, v in rec.items():
+                if k != "ts" and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    self.registry.gauge(k).set(float(v))
         line = json.dumps(rec, sort_keys=True)
-        if self._f:
+        if self._f is not None and not self._closed:
             self._f.write(line + "\n")
             self._f.flush()
         if self.echo:
             print(line, file=sys.stderr)
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def close(self) -> None:
-        if self._f:
+        if self._f is not None and not self._closed:
             self._f.close()
+        self._closed = True
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

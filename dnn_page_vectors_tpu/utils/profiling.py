@@ -77,11 +77,17 @@ class PipelineProfiler:
             return dict(self._n)
 
     def summary(self, prefix: str = "stage_") -> Dict[str, float]:
-        """Flat metrics-ready dict: {f'{prefix}{stage}_s': seconds}. Stable
-        key shape so dashboards/tests can pin on e.g. stage_produce_wait_s."""
+        """Flat metrics-ready dict: {f'{prefix}{stage}_s': seconds,
+        f'{prefix}{stage}_n': calls}. Stable key shape so dashboards/tests
+        can pin on e.g. stage_produce_wait_s — and the per-stage call count
+        next to the cumulative seconds makes mean-per-call computable from
+        ONE metrics line."""
         with self._lock:
-            return {f"{prefix}{k}_s": round(v, 4)
-                    for k, v in sorted(self._sec.items())}
+            out: Dict[str, float] = {}
+            for k in sorted(self._sec):
+                out[f"{prefix}{k}_s"] = round(self._sec[k], 4)
+                out[f"{prefix}{k}_n"] = self._n.get(k, 0)
+            return out
 
 
 class LatencyStats:
@@ -90,15 +96,23 @@ class LatencyStats:
     seconds; this answers the serving question it can't — what one caller
     experiences under load, where the tail (p99) matters more than the
     mean. Thread-safe: concurrent search() callers add into one instance.
+
+    Memory is BOUNDED: samples land in a seeded reservoir
+    (utils/telemetry.Reservoir, Algorithm R) of `cap` slots instead of an
+    ever-growing list, so a long-lived service neither leaks nor re-sorts
+    an unbounded buffer per percentile call. Below `cap` samples the
+    reservoir holds every observation, so count/mean AND the nearest-rank
+    percentiles are exactly what the unbounded version reported (pinned by
+    tests/test_profiling.py); past `cap`, count and mean stay exact and
+    percentiles are estimated from a uniform sample.
     """
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._s: list = []
+    def __init__(self, cap: int = 4096, seed: int = 0) -> None:
+        from dnn_page_vectors_tpu.utils.telemetry import Reservoir
+        self._res = Reservoir(cap=cap, seed=seed)
 
     def add(self, seconds: float) -> None:
-        with self._lock:
-            self._s.append(float(seconds))
+        self._res.add(float(seconds))
 
     @contextlib.contextmanager
     def timed(self):
@@ -109,26 +123,17 @@ class LatencyStats:
             self.add(time.perf_counter() - t0)
 
     def __len__(self) -> int:
-        with self._lock:
-            return len(self._s)
+        return self._res.count
 
     def percentile_ms(self, q: float) -> float:
         """Nearest-rank percentile (q in [0, 100]) in milliseconds; 0.0
         with no samples. p50 of an even count is the lower middle sample —
         a latency the service actually delivered, not an interpolation."""
-        with self._lock:
-            if not self._s:
-                return 0.0
-            s = sorted(self._s)
-        rank = max(0, min(len(s) - 1, int(-(-q * len(s) // 100)) - 1))
-        return s[rank] * 1000.0
+        return self._res.percentile(q) * 1000.0
 
     def summary(self, prefix: str = "lat_") -> Dict[str, float]:
-        with self._lock:
-            n = len(self._s)
-            mean = sum(self._s) / n if n else 0.0
-        return {f"{prefix}count": n,
-                f"{prefix}mean_ms": round(mean * 1000.0, 3),
+        return {f"{prefix}count": self._res.count,
+                f"{prefix}mean_ms": round(self._res.mean * 1000.0, 3),
                 f"{prefix}p50_ms": round(self.percentile_ms(50), 3),
                 f"{prefix}p99_ms": round(self.percentile_ms(99), 3)}
 
