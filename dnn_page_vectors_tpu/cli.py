@@ -15,6 +15,9 @@
   python -m dnn_page_vectors_tpu.cli refresh --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli trace --config cdssm_toy --query "..."
   python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy --watch 2
+  python -m dnn_page_vectors_tpu.cli loadtest --config cdssm_toy \
+      --shape poisson --p99-ms 50 --seed 0
 
 Any config field is overridable with --set section.field=value; every flag
 round-trips through the Config dataclasses (SURVEY.md §5.6).
@@ -110,7 +113,7 @@ def main(argv=None) -> None:
                                         "init-store", "merge-store",
                                         "reset-store", "index", "append",
                                         "refresh", "trace",
-                                        "serve-metrics"])
+                                        "serve-metrics", "loadtest"])
     ap.add_argument("--tombstone", default=None, metavar="IDS",
                     help="append: comma-separated page ids to DELETE (their "
                          "vectors mask out of every retrieval path)")
@@ -156,6 +159,42 @@ def main(argv=None) -> None:
     ap.add_argument("--json", action="store_true",
                     help="serve-metrics: emit the JSON registry snapshot "
                          "instead of the Prometheus text exposition")
+    ap.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="serve-metrics: re-print the live SLO snapshot "
+                         "every N seconds (single-line JSON per tick) "
+                         "instead of one-shot; Ctrl-C stops")
+    # -- loadtest (docs/SERVING.md "SLO methodology") ----------------------
+    ap.add_argument("--shape", default="poisson",
+                    choices=["poisson", "burst", "closed"],
+                    help="loadtest: arrival process — open-loop poisson, "
+                         "open-loop on/off burst, or closed-loop workers")
+    ap.add_argument("--p99-ms", dest="p99_ms", type=float, default=50.0,
+                    help="loadtest: the SLO target — find the max "
+                         "sustained QPS with windowed p99 under this")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="loadtest: workload seed; the same seed replays "
+                         "the identical offered-load schedule")
+    ap.add_argument("--distinct", type=int, default=64,
+                    help="loadtest: distinct queries under the Zipfian "
+                         "repeat distribution")
+    ap.add_argument("--trial-s", dest="trial_s", type=float, default=None,
+                    help="loadtest: measured seconds per trial (default "
+                         "obs.window_s, so the rolling window exactly "
+                         "turns over)")
+    ap.add_argument("--warmup-s", dest="warmup_s", type=float, default=1.0,
+                    help="loadtest: per-trial warmup seconds the rolling "
+                         "window ages out before the measurement")
+    ap.add_argument("--start-qps", dest="start_qps", type=float, default=8.0,
+                    help="loadtest: first offered load probed (workers "
+                         "for --shape closed)")
+    ap.add_argument("--iters", type=int, default=4,
+                    help="loadtest: bisection steps after the doubling "
+                         "phase brackets the p99 cliff")
+    ap.add_argument("--mutate-every", dest="mutate_every", type=float,
+                    default=None, metavar="S",
+                    help="loadtest: hot-swap refresh() every S seconds of "
+                         "trial time — measures serving UNDER live "
+                         "updates (docs/UPDATES.md)")
     ap.add_argument("--faults", default=None, metavar="PLAN",
                     help="fault-injection plan 'op:kind:at[:count],...' "
                          "(utils/faults.py; shorthand for --set "
@@ -550,6 +589,54 @@ def main(argv=None) -> None:
             print(json.dumps({"query": args.query,
                               "degraded": svc.degraded,
                               "results": svc.search(args.query, k=k)}))
+    elif args.command == "loadtest":
+        # SLO harness (docs/SERVING.md "SLO methodology"): replay a seeded
+        # traffic shape against a live micro-batched service and
+        # binary-search offered load for the max sustained QPS meeting the
+        # windowed-p99 target. Every reported number is read from the
+        # telemetry registry; trial progress streams to stderr as
+        # single-line JSON (the serve-metrics --watch format), the final
+        # report is ONE JSON line on stdout.
+        if pi != 0:
+            return
+        import sys
+
+        from dnn_page_vectors_tpu.infer.serve import SearchService
+        from dnn_page_vectors_tpu.loadgen import (
+            Mutator, find_qps_at_p99, make_workload)
+        store = VectorStore(store_dir)
+        svc = SearchService(cfg, embedder, trainer.corpus, store,
+                            preload_hbm_gb=4.0)
+        k = args.topk or cfg.eval.recall_k
+        svc.warmup(k=k)
+        svc.start_batcher()
+        distinct = max(1, args.distinct)
+        queries = [trainer.corpus.query_text(i) for i in range(distinct)]
+        wl = make_workload(args.shape, seed=args.seed, distinct=distinct,
+                           profile=((k, None, 1.0),))
+        mut = (Mutator(svc.refresh, period_s=args.mutate_every)
+               if args.mutate_every else None)
+        trial_s = (args.trial_s if args.trial_s is not None
+                   else cfg.obs.window_s)
+        report = find_qps_at_p99(
+            svc, wl, queries, p99_target_ms=args.p99_ms,
+            start=args.start_qps, iters=args.iters, duration_s=trial_s,
+            warmup_s=args.warmup_s, mutator=mut,
+            progress=lambda line: print(line, file=sys.stderr, flush=True),
+            progress_every_s=max(1.0, trial_s / 2.0))
+        svc.close()
+        report.update({
+            "store_vectors": store.num_vectors,
+            "query_batch": svc.query_batch,
+            "k": k,
+            "serve_index": cfg.serve.index,
+            "batch_window_adaptive": cfg.serve.batch_window_adaptive,
+            "batch_window_ms": round(svc.batch_window_ms, 3),
+            "recompiles": svc.recompiles,
+            "warm_latency_ms": round(svc.warm_latency_ms, 3),
+            "fault_counters": faults.counters(),
+        })
+        print(json.dumps(report))
     elif args.command in ("trace", "serve-metrics"):
         # Observability endpoints (docs/OBSERVABILITY.md). `trace` runs the
         # given queries under request-scoped tracing and exports the span
@@ -567,6 +654,20 @@ def main(argv=None) -> None:
             # one probe query so rate/latency instruments expose live
             # numbers, not an all-zero registry
             svc.search_many([trainer.corpus.query_text(0)], k=k)
+            if args.watch:
+                # live mode: one single-line JSON tick of the windowed SLO
+                # view every N seconds (the same line format the loadtest
+                # driver emits as trial progress); Ctrl-C exits clean
+                import time as _time
+
+                from dnn_page_vectors_tpu.loadgen import snapshot_line
+                try:
+                    while True:
+                        print(snapshot_line(svc), flush=True)
+                        _time.sleep(args.watch)
+                except KeyboardInterrupt:
+                    pass
+                return
             if args.json:
                 print(json.dumps(svc.metrics_snapshot(), sort_keys=True))
             else:

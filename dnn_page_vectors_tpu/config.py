@@ -156,6 +156,20 @@ class ServeConfig:
     # window of extra latency; under load the window fills the compiled
     # bucket and aggregate QPS scales toward bucket width.
     batch_window_ms: float = 2.0
+    # Telemetry-driven adaptive batching (docs/SERVING.md "SLO
+    # methodology"): when on, the micro-batch window WIDENS toward
+    # batch_window_max_ms while the windowed queue-wait p99 (the
+    # serve.queue_wait_ms instrument) climbs past the current window —
+    # requests are stacking faster than dispatches drain, so coalescing
+    # harder buys throughput — and COLLAPSES back toward batch_window_ms
+    # when traffic goes idle. Off (the default) keeps the fixed window:
+    # byte-identical pre-adaptive behavior. The live window is exposed as
+    # the serve.batch_window_ms gauge; every change emits a window_adapt
+    # event (docs/OBSERVABILITY.md).
+    batch_window_adaptive: bool = False
+    # Ceiling for the adaptive window (ms). Bounds the extra latency a
+    # lone caller can ever pay to one max-window flush.
+    batch_window_max_ms: float = 25.0
     # Most queries one coalesced dispatch may carry (tiled over full
     # compiled buckets inside search_many). Bounds per-dispatch latency.
     max_batch: int = 32

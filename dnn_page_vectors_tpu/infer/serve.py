@@ -125,14 +125,22 @@ class _MicroBatcher:
     query must not fail its batch-mates), the batch is retried one request
     at a time so the exception lands on exactly the failing request's
     future; the rest still get results.
+
+    The coalescing window is read from the service PER BATCH (`window_s`
+    callable): with `serve.batch_window_adaptive` the AdaptiveWindow
+    controller moves it between the configured base and
+    `serve.batch_window_max_ms` off the windowed queue-wait p99, and every
+    measured queue wait feeds the serve.queue_wait_ms instrument the
+    controller reads — the control loop closes through the registry, not
+    through ad-hoc state.
     """
 
     _STOP = object()
 
-    def __init__(self, svc: "SearchService", window_ms: float,
-                 max_batch: int, max_queue: int):
+    def __init__(self, svc: "SearchService", window_s, max_batch: int,
+                 max_queue: int):
         self._svc = svc
-        self._window = max(0.0, float(window_ms)) / 1000.0
+        self._window_s = window_s            # () -> seconds, read per batch
         self._max = max(1, int(max_batch))
         self._q: "queue_mod.Queue[object]" = queue_mod.Queue(
             maxsize=max(self._max, int(max_queue)))
@@ -141,13 +149,14 @@ class _MicroBatcher:
                                    name="serve-batcher")
         self._t.start()
 
-    def submit(self, query: str, k: Optional[int]) -> Future:
+    def submit(self, query: str, k: Optional[int],
+               nprobe: Optional[int] = None) -> Future:
         fut: Future = Future()
         # capture the caller's active span HERE: the dispatcher runs on
         # another thread where the contextvar chain breaks, so the trace
         # context rides the queue explicitly (docs/OBSERVABILITY.md)
         ctx = self._svc.tracer.current()
-        self._q.put((query, k, fut, time.perf_counter(), ctx))
+        self._q.put((query, (k, nprobe), fut, time.perf_counter(), ctx))
         return fut
 
     def _run(self) -> None:
@@ -156,7 +165,7 @@ class _MicroBatcher:
             if item is self._STOP:
                 return
             batch = [item]
-            deadline = time.perf_counter() + self._window
+            deadline = time.perf_counter() + max(0.0, self._window_s())
             while len(batch) < self._max:
                 rem = deadline - time.perf_counter()
                 try:
@@ -169,21 +178,23 @@ class _MicroBatcher:
                     return
                 batch.append(nxt)
             self._dispatch(batch)
+            self._svc._adapt_window()
 
     def _dispatch(self, batch) -> None:
         tracer = self._svc.tracer
         now = time.perf_counter()
         for _, _, _, t0, ctx in batch:
             self._svc.profiler.add("queue_wait", now - t0)
+            self._svc._m_queue_wait.observe((now - t0) * 1000.0)
             if ctx is not None:
                 # finished child stamped onto the REQUEST's tree: how long
                 # this request sat in the queue before its dispatch
                 ctx.child("queue_wait", now - t0, t0=t0)
         self.batch_sizes.append(len(batch))
-        by_k: Dict[Optional[int], list] = {}
-        for query, k, fut, _, ctx in batch:
-            by_k.setdefault(k, []).append((query, fut, ctx))
-        for k, items in by_k.items():
+        by_key: Dict[tuple, list] = {}
+        for query, key, fut, _, ctx in batch:
+            by_key.setdefault(key, []).append((query, fut, ctx))
+        for (k, nprobe), items in by_key.items():
             try:
                 # the coalesced dispatch traces ONCE under a detached root
                 # (record=False: it only exists grafted into request
@@ -192,7 +203,8 @@ class _MicroBatcher:
                 with tracer.trace("dispatch", record=False,
                                   batch_size=len(items)) as dsp:
                     res = self._svc.search_many(
-                        [q for q, _, _ in items], k=k, _record=False)
+                        [q for q, _, _ in items], k=k, nprobe=nprobe,
+                        _record=False)
             except BaseException:  # noqa: BLE001 — isolate per request
                 for q, fut, ctx in items:
                     try:
@@ -200,7 +212,8 @@ class _MicroBatcher:
                         # on THIS thread so retry spans nest under it
                         with tracer.use(ctx):
                             fut.set_result(self._svc.search_many(
-                                [q], k=k, _record=False)[0])
+                                [q], k=k, nprobe=nprobe,
+                                _record=False)[0])
                     except BaseException as e:  # noqa: BLE001
                         fut.set_exception(e)
                 continue
@@ -212,6 +225,79 @@ class _MicroBatcher:
     def close(self) -> None:
         self._q.put(self._STOP)
         self._t.join()
+
+
+class AdaptiveWindow:
+    """Telemetry-driven micro-batch window controller (docs/SERVING.md).
+
+    The fixed `serve.batch_window_ms` is a compromise: too narrow and a
+    loaded service dispatches half-empty buckets, too wide and a lone
+    caller pays the whole window as latency. This controller moves the
+    window between `base_ms` and `max_ms` off ONE signal, the windowed
+    queue-wait p99 from the serve.queue_wait_ms histogram (the PR-7
+    registry, not wall-clock re-derivation):
+
+      * pressure — queue-wait p99 >= `pressure_ratio` x the current
+        window (requests are stacking behind in-flight dispatches, not
+        just riding out the window) -> double the window, capped at
+        `max_ms`. Wider window = fuller buckets = fewer dispatches per
+        second = the queue drains.
+      * idle — no queue-wait samples in the rolling window, or a p99
+        below `idle_ratio` x the current window -> halve back toward
+        `base_ms`, so the next lone caller pays base latency again.
+
+    Note the discriminator: a lone caller's queue wait ~= the window
+    itself (it sits in the batch while the dispatcher waits out the
+    window), which lands BETWEEN the idle and pressure thresholds — a
+    quiet trickle of traffic holds the window steady instead of
+    oscillating. Every change sets the serve.batch_window_ms gauge and
+    emits a `window_adapt` event with the p99 that drove it."""
+
+    def __init__(self, base_ms: float, max_ms: float, queue_wait,
+                 gauge=None, on_change=None, pressure_ratio: float = 1.5,
+                 idle_ratio: float = 0.25, min_samples: int = 4):
+        self.base_ms = max(0.1, float(base_ms))
+        self.max_ms = max(self.base_ms, float(max_ms))
+        self._queue_wait = queue_wait        # Histogram (windowed)
+        self._gauge = gauge
+        self._on_change = on_change
+        self.pressure_ratio = float(pressure_ratio)
+        self.idle_ratio = float(idle_ratio)
+        self.min_samples = max(1, int(min_samples))
+        self._cur = self.base_ms
+        self._lock = threading.Lock()
+        if gauge is not None:
+            gauge.set(self._cur)
+
+    @property
+    def current_ms(self) -> float:
+        with self._lock:
+            return self._cur
+
+    def current_s(self) -> float:
+        return self.current_ms / 1000.0
+
+    def update(self) -> float:
+        """One control step: read the windowed queue-wait stats, move the
+        window if warranted, return the (possibly new) window in ms."""
+        n = self._queue_wait.window_count()
+        p99 = self._queue_wait.window_percentile(99)
+        with self._lock:
+            cur = self._cur
+            new, reason = cur, None
+            if n >= self.min_samples and p99 >= self.pressure_ratio * cur:
+                new, reason = min(self.max_ms, cur * 2.0), "pressure"
+            elif cur > self.base_ms and (
+                    n == 0 or p99 <= self.idle_ratio * cur):
+                new, reason = max(self.base_ms, cur / 2.0), "idle"
+            if new == cur:
+                return cur
+            self._cur = new
+        if self._gauge is not None:
+            self._gauge.set(new)
+        if self._on_change is not None:
+            self._on_change(cur, new, p99, reason)
+        return new
 
 
 class _ServeView:
@@ -305,6 +391,19 @@ class SearchService:
         self._m_rebuilds = reg.counter("serve.full_rebuilds")
         self._m_restage_skipped = reg.counter("serve.restage_skipped")
         self._m_restage_forced = reg.counter("serve.restage_forced")
+        # queue-wait distribution behind the adaptive-batching control
+        # loop (docs/SERVING.md): the micro-batcher observes every
+        # request's measured wait here; AdaptiveWindow reads the windowed
+        # p99 back out
+        self._m_queue_wait = reg.histogram("serve.queue_wait_ms",
+                                           window_s=window_s, cap=reservoir)
+        # recompilation visibility (docs/OBSERVABILITY.md): the serving
+        # path tracks every (program, shape) key it dispatches; a
+        # first-seen key means XLA compiles — the classic hidden p99
+        # cliff an SLO trial would otherwise misattribute to load
+        self._m_recompiles = reg.counter("serve.recompiles")
+        self._compiled_keys: set = set()
+        self._compiled_lock = threading.Lock()
         # LRU query-embedding cache: normalized text + the store's model
         # step -> host fp32 query vector. Step in the KEY means a store
         # re-stamp (ensure_model_step) invalidates without a flush.
@@ -339,6 +438,23 @@ class SearchService:
         self._restage_density = (
             getattr(upd_cfg, "restage_tombstone_density", 0.05)
             if upd_cfg is not None else 0.05)
+        # micro-batch window: fixed at serve.batch_window_ms, or driven by
+        # the AdaptiveWindow controller under serve.batch_window_adaptive
+        # (off by default — the fixed path is byte-identical to before).
+        # The live window is always readable as the serve.batch_window_ms
+        # gauge; every adaptive change emits a window_adapt event.
+        self._window_base_ms = (getattr(serve_cfg, "batch_window_ms", 2.0)
+                                if serve_cfg is not None else 2.0)
+        win_gauge = reg.gauge("serve.batch_window_ms")
+        win_gauge.set(self._window_base_ms)
+        self._window_ctl: Optional[AdaptiveWindow] = None
+        if serve_cfg is not None and getattr(
+                serve_cfg, "batch_window_adaptive", False):
+            self._window_ctl = AdaptiveWindow(
+                self._window_base_ms,
+                getattr(serve_cfg, "batch_window_max_ms", 25.0),
+                self._m_queue_wait, gauge=win_gauge,
+                on_change=self._on_window_adapt)
         self._batcher: Optional[_MicroBatcher] = None
         self._batch_sizes: List[int] = []   # telemetry after close()
         self._log = log
@@ -465,6 +581,53 @@ class SearchService:
     def _count_fault(self, name: str) -> None:
         self.fault_counters[name] = self.fault_counters.get(name, 0) + 1
         faults.count(name)
+
+    # -- adaptive batching (docs/SERVING.md) -------------------------------
+    @property
+    def batch_window_ms(self) -> float:
+        """The micro-batch window currently in force (ms): the configured
+        base, or wherever the adaptive controller has moved it."""
+        return (self._window_ctl.current_ms if self._window_ctl is not None
+                else self._window_base_ms)
+
+    def _adapt_window(self) -> None:
+        """One adaptive-window control step; no-op with adaptation off.
+        Called by the micro-batcher after every dispatch."""
+        if self._window_ctl is not None:
+            self._window_ctl.update()
+
+    def _on_window_adapt(self, old_ms: float, new_ms: float,
+                         queue_wait_p99_ms: float, reason: str) -> None:
+        cur = self.tracer.current()
+        self.registry.event("window_adapt", {
+            "old_ms": round(old_ms, 3), "new_ms": round(new_ms, 3),
+            "queue_wait_p99_ms": round(queue_wait_p99_ms, 3),
+            "reason": reason,
+        }, trace_id=cur.trace_id if cur is not None else None)
+
+    # -- recompilation visibility (docs/OBSERVABILITY.md) ------------------
+    @property
+    def recompiles(self) -> int:
+        return self._m_recompiles.value
+
+    def _note_dispatch_shape(self, program: str, **shape) -> None:
+        """Count a jit cache miss when the serving path dispatches a
+        (program, shape) key it has never dispatched before — first-seen
+        keys are exactly the dispatches XLA must compile for. Silent
+        recompiles (a new k, a ragged bucket, a refresh changing pad_rows)
+        are the classic hidden p99 cliff; the `recompile` event carries
+        the bucket shape so an SLO trial's latency spike attributes to
+        the compile, not to offered load."""
+        key = (program, tuple(sorted(shape.items())))
+        with self._compiled_lock:
+            if key in self._compiled_keys:
+                return
+            self._compiled_keys.add(key)
+        self._m_recompiles.inc()
+        cur = self.tracer.current()
+        self.registry.event("recompile", {"program": program, **shape},
+                            trace_id=cur.trace_id if cur is not None
+                            else None)
 
     # -- hot-swap refresh (docs/UPDATES.md) --------------------------------
     def refresh(self, update_index: Optional[bool] = None) -> Dict:
@@ -621,19 +784,26 @@ class SearchService:
             faults.warn(f"IVF index update failed ({view.index_error}); "
                         "serving the exact path until a rebuild")
 
-    def _search_ann(self, view: "_ServeView", qv: np.ndarray, n: int, k: int
+    def _search_ann(self, view: "_ServeView", qv: np.ndarray, n: int, k: int,
+                    nprobe: Optional[int] = None
                     ) -> Optional[List[List[Dict]]]:
         """ANN answer for `n` real queries, or None to fall back to the
         exact path (index missing, stale against the view store's CURRENT
         model step, or failing at search time — the failure quarantine
-        already happened inside the index layer)."""
+        already happened inside the index layer). `nprobe` overrides the
+        serve.nprobe default per request (mixed-profile load tests)."""
         idx = view.index
         if idx is None or idx.model_step != view.store.model_step:
             return None
+        nprobe = nprobe or self._nprobe
+        # the index pads queries to a power-of-two bucket internally:
+        # mirror that key so the counter moves exactly when XLA compiles
+        self._note_dispatch_shape("ivf_search", k=k, nprobe=nprobe,
+                                  qpad=1 << (max(1, n) - 1).bit_length())
         try:
             with self._stage("topk") as sp:
                 scores, ids, st = idx.search(
-                    qv[:n], k=k, nprobe=self._nprobe,
+                    qv[:n], k=k, nprobe=nprobe,
                     rerank=self._pq_rerank or None)
                 # the ANN cost triple ON the request's span (why THIS
                 # query was slow): lists probed, payload bytes gathered,
@@ -875,6 +1045,8 @@ class SearchService:
             if pad:
                 enc = np.concatenate(
                     [enc, np.zeros((pad,) + enc.shape[1:], enc.dtype)])
+            self._note_dispatch_shape("encode_query", batch=B,
+                                      tokens=int(enc.shape[1]))
             with self._stage("encode", queries=len(grp)):
                 vecs = np.asarray(
                     self.embedder._encode_query(self.embedder.params,
@@ -900,7 +1072,12 @@ class SearchService:
         stops it."""
         if self._batcher is None:
             s = self.cfg.serve
-            self._batcher = _MicroBatcher(self, s.batch_window_ms,
+            # the batcher reads the window per batch: fixed base, or
+            # wherever the adaptive controller currently has it
+            window_s = (self._window_ctl.current_s
+                        if self._window_ctl is not None
+                        else lambda: self._window_base_ms / 1000.0)
+            self._batcher = _MicroBatcher(self, window_s,
                                           s.max_batch, s.max_queue)
         return self
 
@@ -948,6 +1125,11 @@ class SearchService:
             # tombstone-aware restage policy (docs/UPDATES.md)
             "restage_skipped": self.restage_skipped,
             "restage_forced": self.restage_forced,
+            # recompilation + adaptive-window state (docs/SERVING.md):
+            # how many distinct compiled shapes this service has
+            # dispatched, and the micro-batch window currently in force
+            "serve_recompiles": self.recompiles,
+            "serve_batch_window_ms": round(self.batch_window_ms, 3),
             **self._window_metrics(),
             **self.profiler.summary(prefix="serve_stage_"),
         }
@@ -997,6 +1179,8 @@ class SearchService:
                 hit_w / (hit_w + miss_w), 4) if (hit_w + miss_w) else 0.0,
             "serve_window_p50_ms": round(lat.window_percentile(50), 3),
             "serve_window_p99_ms": round(lat.window_percentile(99), 3),
+            "serve_window_queue_wait_p99_ms": round(
+                self._m_queue_wait.window_percentile(99), 3),
         }
 
     # -- exposition (docs/OBSERVABILITY.md) --------------------------------
@@ -1034,23 +1218,26 @@ class SearchService:
             self._cache_cap = cap
         self.warm_latency_ms = lat.percentile_ms(50)
 
-    def search(self, query: str, k: Optional[int] = None) -> List[Dict]:
+    def search(self, query: str, k: Optional[int] = None,
+               nprobe: Optional[int] = None) -> List[Dict]:
         """One query -> top-k results. With the micro-batcher running
         (start_batcher), the call enqueues and blocks on its future —
         concurrent callers share dispatches; otherwise it is a direct
         single-query search_many. Either way the request is traced
         (obs.enabled) and lands in the windowed latency/qps instruments:
         the batched path's trace follows the request THROUGH the
-        dispatcher thread (queue_wait + the adopted shared dispatch)."""
+        dispatcher thread (queue_wait + the adopted shared dispatch).
+        `nprobe` overrides serve.nprobe for this request on an IVF
+        service (the batcher coalesces per distinct (k, nprobe))."""
         b = self._batcher
         if b is None:
-            return self.search_many([query], k=k)[0]
+            return self.search_many([query], k=k, nprobe=nprobe)[0]
         t0 = time.perf_counter()
         try:
             with self.tracer.trace("search",
                                    k=k or self.cfg.eval.recall_k,
                                    query=self._normalize(query)[:80]):
-                res = b.submit(query, k).result()
+                res = b.submit(query, k, nprobe).result()
         except BaseException:
             self._m_errors.inc()
             raise
@@ -1059,6 +1246,7 @@ class SearchService:
         return res
 
     def search_many(self, queries: Sequence[str], k: Optional[int] = None,
+                    nprobe: Optional[int] = None,
                     *, _record: bool = True) -> List[List[Dict]]:
         """Vectorized multi-query search: one result list per query, in
         order. Queries fill the compiled `query_batch` bucket (larger lists
@@ -1084,7 +1272,7 @@ class SearchService:
         t0 = time.perf_counter()
         try:
             with self.tracer.root_or_span("search_many", n_queries=n, k=k):
-                out = self._search_view(view, list(queries), n, k)
+                out = self._search_view(view, list(queries), n, k, nprobe)
         except BaseException:
             if _record:
                 self._m_errors.inc(n)
@@ -1096,10 +1284,11 @@ class SearchService:
         return out
 
     def _search_view(self, view: "_ServeView", queries: List[str],
-                     n: int, k: int) -> List[List[Dict]]:
+                     n: int, k: int,
+                     nprobe: Optional[int] = None) -> List[List[Dict]]:
         qv = self._embed_queries_cached(queries)
         if self._serve_index == "ivf":
-            res = self._search_ann(view, qv, n, k)
+            res = self._search_ann(view, qv, n, k, nprobe)
             if res is not None:
                 return res
             # exact path serves this request; visible in metrics + counters
@@ -1118,6 +1307,7 @@ class SearchService:
             if pad:
                 qv = np.concatenate(
                     [qv, np.zeros((pad, qv.shape[1]), np.float32)])
+            self._note_dispatch_shape("topk_over_store", batch=B, k=k)
             with self._stage("topk", path="streaming"):
                 scores, ids = topk_over_store(qv, view.store,
                                               self.embedder.mesh, k=k,
@@ -1156,6 +1346,9 @@ class SearchService:
             qblock = np.concatenate(
                 [qblock, np.zeros((B - nreal, qblock.shape[1]), np.float32)])
         q = jnp.asarray(qblock, jnp.float32)
+        self._note_dispatch_shape("sharded_topk", batch=B, k=k,
+                                  rows=view.pad_rows,
+                                  shards=len(view.shards))
         with self._stage("topk", shards=len(view.shards)):
             cands = [
                 sharded_topk(q, pages, self.embedder.mesh, k=k, valid=n,

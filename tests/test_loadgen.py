@@ -1,0 +1,450 @@
+"""SLO harness (dnn_page_vectors_tpu/loadgen/, docs/SERVING.md "SLO
+methodology"): seeded arrival processes are deterministic and hit their
+nominal rates on a fake clock, the adaptive micro-batch window widens
+under synthetic queue pressure and decays when idle (fake clock, no
+sleeps), the driver's binary search converges on a stubbed service with a
+known latency/load curve, `cli loadtest` emits the pinned JSON report
+shape with seed-identical offered-load schedules, and the concurrent
+append/refresh mutator variant serves through hot-swaps with
+`full_rebuilds == 0`."""
+import json
+import os
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.loadgen import (
+    Mutator, QueryMix, Workload, find_qps_at_p99, make_workload, run_trial,
+    snapshot_line)
+from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils.telemetry import MetricsRegistry
+
+pytestmark = pytest.mark.slo
+
+
+# ---------------------------------------------------------------------------
+# workload models: determinism + nominal rates (no service, no sleeps)
+# ---------------------------------------------------------------------------
+
+def test_poisson_schedule_is_seed_deterministic_and_near_nominal_rate():
+    a = make_workload("poisson", seed=11, distinct=32)
+    b = make_workload("poisson", seed=11, distinct=32)
+    s1, s2 = a.schedule(10.0, 200.0), b.schedule(10.0, 200.0)
+    assert s1 == s2                       # identical offered-load schedule
+    assert Workload.digest(s1) == Workload.digest(s2)
+    assert 0.85 * 2000 < len(s1) < 1.15 * 2000
+    times = [t for t, _ in s1]
+    assert times == sorted(times) and all(0 <= t < 10.0 for t in times)
+    # a different seed is a different schedule
+    s3 = make_workload("poisson", seed=12, distinct=32).schedule(10.0, 200.0)
+    assert Workload.digest(s3) != Workload.digest(s1)
+    # and a re-derived RNG per call: the same workload replays itself
+    assert a.schedule(10.0, 200.0) == s1
+
+
+def test_burst_schedule_has_on_off_structure_and_preserved_mean_rate():
+    wl = make_workload("burst", seed=5, distinct=16, on_s=0.5, off_s=0.5)
+    sched = wl.schedule(6.0, 60.0)
+    assert sched == make_workload("burst", seed=5, distinct=16, on_s=0.5,
+                                  off_s=0.5).schedule(6.0, 60.0)
+    # arrivals land ONLY inside the on-windows of the 1 s period
+    assert all((t % 1.0) < 0.5 for t, _ in sched)
+    # duty-cycle scaling preserves the MEAN offered rate
+    assert 0.75 * 360 < len(sched) < 1.25 * 360
+
+
+def test_closed_loop_worker_streams_are_seeded_per_worker():
+    wl = make_workload("closed", seed=3, distinct=8, think_s=0.01)
+    assert wl.think_s == 0.01
+    s0 = [r for r, _ in zip(wl.worker_stream(0), range(20))]
+    again = [r for r, _ in zip(wl.worker_stream(0), range(20))]
+    assert s0 == again                    # same worker, same stream
+    s1 = [r for r, _ in zip(wl.worker_stream(1), range(20))]
+    assert s0 != s1                       # workers draw distinct streams
+
+
+def test_query_mix_is_head_skewed_with_mixed_profile():
+    rng = np.random.default_rng(0)
+    mix = QueryMix(distinct=50, alpha=1.1,
+                   profile=((10, None, 0.75), (50, 4, 0.25)))
+    reqs = mix.sample(rng, 4000)
+    counts = np.bincount([r.query_id for r in reqs], minlength=50)
+    assert counts[0] == counts.max()      # rank 0 is the head query
+    assert counts[0] > 4 * counts[25:].mean()
+    ks = {(r.k, r.nprobe) for r in reqs}
+    assert ks == {(10, None), (50, 4)}    # both profile entries drawn
+    frac_k50 = sum(r.k == 50 for r in reqs) / len(reqs)
+    assert 0.18 < frac_k50 < 0.32
+    # alpha=0 degrades to uniform: the head loses its dominance
+    uni = QueryMix(distinct=50, alpha=0.0).sample(
+        np.random.default_rng(0), 4000)
+    ucounts = np.bincount([r.query_id for r in uni], minlength=50)
+    assert ucounts[0] < 2.5 * ucounts[25:].mean()
+
+
+# ---------------------------------------------------------------------------
+# adaptive window: the control loop on a fake clock (no sleeps)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += max(0.0, dt)
+
+
+def test_adaptive_window_widens_under_pressure_and_decays_when_idle():
+    from dnn_page_vectors_tpu.infer.serve import AdaptiveWindow
+    clock = _FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    qw = reg.histogram("serve.queue_wait_ms", window_s=10.0)
+    gauge = reg.gauge("serve.batch_window_ms")
+    changes = []
+    ctl = AdaptiveWindow(2.0, 25.0, qw, gauge=gauge,
+                         on_change=lambda *a: changes.append(a))
+    assert ctl.current_ms == 2.0 and gauge.value == 2.0
+    # synthetic queue pressure: waits far above the current window
+    for _ in range(8):
+        qw.observe(50.0)
+    assert ctl.update() == 4.0            # 2 -> 4
+    assert ctl.update() == 8.0            # the pressure persists
+    assert ctl.update() == 16.0
+    assert ctl.update() == 25.0           # capped at batch_window_max_ms
+    assert ctl.update() == 25.0
+    assert gauge.value == 25.0
+    assert all(c[3] == "pressure" for c in changes)
+    # a lone caller's wait ~= the window itself: NO change either way
+    clock.t = 20.0                        # pressure samples age out
+    for _ in range(8):
+        qw.observe(25.0)
+    assert ctl.update() == 25.0
+    # idle: the rolling window empties -> decay back toward the base
+    clock.t = 40.0
+    assert ctl.update() == 12.5
+    assert ctl.update() == 6.25
+    assert ctl.update() == 3.125
+    assert ctl.update() == 2.0            # floored at the configured base
+    assert ctl.update() == 2.0
+    assert gauge.value == 2.0
+    assert changes[-1][3] == "idle"
+
+
+# ---------------------------------------------------------------------------
+# driver: binary search on a stub with a known latency/load curve
+# ---------------------------------------------------------------------------
+
+class _StubService:
+    """p99 = base_ms up to knee_qps, then a cubic blow-up — the analytic
+    'qps @ p99 < X' is solvable in closed form, so the driver's answer is
+    checkable: p99(q) = base * (q/knee)^3 above the knee."""
+
+    def __init__(self, clock, knee_qps=100.0, base_ms=5.0, window_s=10.0):
+        self.clock = clock
+        self.knee = knee_qps
+        self.base = base_ms
+        self.window = window_s
+        self.registry = MetricsRegistry(clock=clock)
+        self.times = deque()
+        self.calls = 0
+
+    def search(self, query, k=None, nprobe=None):
+        self.calls += 1
+        self.times.append(self.clock())
+        return []
+
+    def metrics(self):
+        now = self.clock()
+        while self.times and self.times[0] < now - self.window:
+            self.times.popleft()
+        rate = len(self.times) / self.window
+        p99 = (self.base if rate <= self.knee
+               else self.base * (rate / self.knee) ** 3)
+        return {"serve_window_qps": round(rate, 3),
+                "serve_window_p50_ms": p99 / 2.0,
+                "serve_window_p99_ms": p99,
+                "serve_window_error_rate": 0.0,
+                "serve_window_cache_hit_rate": 0.0,
+                "serve_batch_window_ms": 2.0,
+                "serve_recompiles": 0}
+
+
+def test_driver_binary_search_converges_on_known_curve():
+    clock = _FakeClock()
+    svc = _StubService(clock)             # analytic answer: 200 qps @ 40 ms
+    wl = make_workload("poisson", seed=0, distinct=16)
+    rep = find_qps_at_p99(svc, wl, [f"q{i}" for i in range(16)],
+                          p99_target_ms=40.0, start=25.0, iters=6,
+                          duration_s=10.0, warmup_s=0.0, workers=0,
+                          clock=clock, sleep=clock.sleep)
+    assert 180.0 <= rep["qps_at_p99"] <= 220.0
+    assert rep["p99_target_ms"] == 40.0 and rep["shape"] == "poisson"
+    assert len(rep["trials"]) >= 5
+    # every trial number was read back from the service's registry view
+    for tr in rep["trials"]:
+        for key in ("offered_qps", "achieved_qps", "p50_ms", "p99_ms",
+                    "error_rate", "cache_hit_rate", "met",
+                    "schedule_digest", "events"):
+            assert key in tr
+        assert tr["achieved_qps"] == pytest.approx(tr["offered_qps"],
+                                                   rel=0.15)
+    met = [tr for tr in rep["trials"] if tr["met"]]
+    unmet = [tr for tr in rep["trials"] if not tr["met"]]
+    assert met and unmet                  # the search bracketed the cliff
+    assert max(t["offered_qps"] for t in met) <= \
+        min(t["offered_qps"] for t in unmet)
+
+
+def test_driver_trial_correlates_lifecycle_events_and_runs_mutator():
+    clock = _FakeClock()
+    svc = _StubService(clock)
+    svc.registry.event("stale", {"before": True})    # pre-trial: excluded
+    fired = []
+
+    def _mutate():
+        fired.append(clock())
+        svc.registry.event("view_swap", {"swap_ms": 1.0})
+
+    wl = make_workload("poisson", seed=1, distinct=4)
+    tr = run_trial(svc, wl, 50.0, ["a", "b", "c", "d"], duration_s=10.0,
+                   warmup_s=0.0, workers=0, clock=clock, sleep=clock.sleep,
+                   mutator=Mutator(_mutate, period_s=2.5))
+    assert tr["mutator_calls"] == len(fired) >= 3
+    names = [e["event"] for e in tr["events"]]
+    assert "view_swap" in names and "stale" not in names
+    assert tr["requests_sent"] == svc.calls
+    # two identical runs replay the identical offered-load schedule
+    svc2 = _StubService(_FakeClock())
+    tr2 = run_trial(svc2, make_workload("poisson", seed=1, distinct=4),
+                    50.0, ["a", "b", "c", "d"], duration_s=10.0,
+                    warmup_s=0.0, workers=0, clock=svc2.clock,
+                    sleep=svc2.clock.sleep)
+    assert tr2["schedule_digest"] == tr["schedule_digest"]
+
+
+def test_snapshot_line_is_single_line_json():
+    svc = _StubService(_FakeClock())
+    line = snapshot_line(svc, {"offered": 10.0})
+    assert "\n" not in line
+    rec = json.loads(line)
+    assert rec["offered"] == 10.0 and "window_qps" in rec
+
+
+# ---------------------------------------------------------------------------
+# end to end on a trained toy store
+# ---------------------------------------------------------------------------
+
+_OV = {
+    "data.num_pages": 300,
+    "data.trigram_buckets": 2048,
+    "model.embed_dim": 48,
+    "model.conv_channels": 96,
+    "model.out_dim": 48,
+    "train.batch_size": 64,
+    "train.steps": 60,
+    "train.warmup_steps": 10,
+    "train.learning_rate": 2e-3,
+    "train.log_every": 1000,
+    "eval.embed_batch_size": 100,
+    "eval.store_shard_size": 100,   # 3 shards: exercises the device merge
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One trained model + embedded 3-shard store, with the checkpoint
+    saved so `cli loadtest` can restore it from the same workdir."""
+    from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    from dnn_page_vectors_tpu.train.checkpoint import CheckpointManager
+    from dnn_page_vectors_tpu.train.loop import Trainer
+    wd = str(tmp_path_factory.mktemp("loadgen_serve"))
+    cfg = get_config("cdssm_toy", _OV)
+    trainer = Trainer(cfg, workdir=wd)
+    state, _ = trainer.train()
+    mgr = CheckpointManager(os.path.join(wd, "ckpt"))
+    mgr.save(int(state.step), state, wait=True)
+    mgr.close()
+    emb = BulkEmbedder(cfg, trainer.model, state.params, trainer.page_tok,
+                       trainer.mesh, query_tok=trainer.query_tok)
+    store = VectorStore(os.path.join(wd, "store"), dim=cfg.model.out_dim,
+                        shard_size=100)
+    store.ensure_model_step(int(state.step))
+    emb.embed_corpus(trainer.corpus, store)
+    return wd, cfg, trainer, emb, store
+
+
+def _cfg_with(cfg, serve=None, obs=None, updates=None):
+    import dataclasses
+    out = cfg
+    for name, over in (("serve", serve), ("obs", obs), ("updates", updates)):
+        if over:
+            out = out.replace(**{name: dataclasses.replace(
+                getattr(out, name), **over)})
+    return out
+
+
+def test_adaptive_batched_results_equal_sequential(served):
+    """The acceptance pin: batched == sequential still holds with
+    adaptive batching ON — the window moving under load must never change
+    results, only coalescing."""
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    _, cfg, trainer, emb, store = served
+    acfg = _cfg_with(cfg, serve={"batch_window_adaptive": True,
+                                 "batch_window_max_ms": 10.0})
+    svc = SearchService(acfg, emb, trainer.corpus, store,
+                        preload_hbm_gb=4.0)
+    assert svc._window_ctl is not None    # knob actually engaged
+    plain = SearchService(cfg, emb, trainer.corpus, store,
+                          preload_hbm_gb=4.0)
+    assert plain._window_ctl is None      # off by default
+    qis = [0, 7, 42, 123, 299, 5, 13, 77, 200, 250, 1, 2, 3, 4, 6, 8]
+    queries = [trainer.corpus.query_text(qi) for qi in qis]
+    want = plain.search_many(queries, k=10)
+    svc.start_batcher()
+    try:
+        with ThreadPoolExecutor(8) as ex:
+            got = list(ex.map(lambda q: svc.search(q, k=10), queries))
+    finally:
+        svc.close()
+    for a, b in zip(got, want):
+        assert [r["page_id"] for r in a] == [r["page_id"] for r in b]
+        np.testing.assert_allclose([r["score"] for r in a],
+                                   [r["score"] for r in b], atol=1e-4)
+    # the live window is exposed whichever way it moved
+    assert svc.registry.gauge("serve.batch_window_ms").value >= 2.0
+    assert svc.metrics()["serve_batch_window_ms"] >= 2.0
+
+
+def test_recompile_counter_moves_on_new_shapes_only(served):
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    _, cfg, trainer, emb, store = served
+    svc = SearchService(cfg, emb, trainer.corpus, store, preload_hbm_gb=4.0)
+    q = trainer.corpus.query_text(9)
+    svc.search_many([q], k=10)
+    first = svc.recompiles
+    assert first >= 2                     # encode + topk compiled
+    evs = svc.registry.events("recompile")
+    assert len(evs) == first
+    assert {e["attrs"]["program"] for e in evs} >= {"encode_query",
+                                                    "sharded_topk"}
+    assert all("batch" in e["attrs"] for e in evs)
+    svc.search_many([trainer.corpus.query_text(10)], k=10)
+    assert svc.recompiles == first        # warm shapes: no new compiles
+    svc.search_many([q], k=7)             # a NEW k = a new top-k program
+    assert svc.recompiles == first + 1
+    assert svc.metrics()["serve_recompiles"] == first + 1
+
+
+def test_cli_loadtest_json_report_shape_and_seed_determinism(served,
+                                                             capsys):
+    from dnn_page_vectors_tpu import cli
+    wd, _, _, _, _ = served
+
+    def _run():
+        cli.main(["loadtest", "--config", "cdssm_toy", "--workdir", wd,
+                  "--shape", "poisson", "--p99-ms", "0.5", "--seed", "7",
+                  "--distinct", "8", "--trial-s", "0.6", "--warmup-s",
+                  "0.2", "--start-qps", "32", "--iters", "1",
+                  "--set", "obs.window_s=0.6",
+                  "--set", "serve.batch_window_adaptive=true"]
+                 + [x for key, val in _OV.items()
+                    for x in ("--set", f"{key}={val}")])
+        out = capsys.readouterr().out.strip().splitlines()
+        return json.loads(out[-1])
+
+    rep = _run()
+    # the pinned report shape: qps_at_p99 + per-trial registry-read
+    # offered/achieved/p50/p99 + correlated lifecycle events
+    for key in ("qps_at_p99", "p99_target_ms", "shape", "seed", "trials",
+                "events", "store_vectors", "recompiles",
+                "batch_window_adaptive", "fault_counters"):
+        assert key in rep, key
+    assert rep["shape"] == "poisson" and rep["seed"] == 7
+    assert rep["p99_target_ms"] == 0.5 and rep["store_vectors"] == 300
+    assert rep["batch_window_adaptive"] is True
+    assert len(rep["trials"]) >= 2
+    for tr in rep["trials"]:
+        for key in ("offered_qps", "achieved_qps", "p50_ms", "p99_ms",
+                    "error_rate", "cache_hit_rate", "met", "events",
+                    "schedule_digest"):
+            assert key in tr, key
+        assert tr["errors"] == 0
+        assert tr["achieved_qps"] > 0     # real traffic hit the registry
+    # an impossible 0.5 ms target: no trial can pass, the search brackets
+    # downward deterministically -> the two runs probe the same loads
+    assert all(not tr["met"] for tr in rep["trials"])
+    rep2 = _run()
+    assert [t["schedule_digest"] for t in rep2["trials"]] == \
+        [t["schedule_digest"] for t in rep["trials"]]
+    assert [t["offered_qps"] for t in rep2["trials"]] == \
+        [t["offered_qps"] for t in rep["trials"]]
+
+
+def test_mutator_hot_swap_under_fire_no_full_rebuilds(served, tmp_path):
+    """The append/refresh mutator exercises the zero-downtime hot-swap
+    path DURING a load trial: incremental index updates only
+    (full_rebuilds == 0 pinned), view_swap events correlated into the
+    trial record, and zero request errors across the swaps."""
+    from dnn_page_vectors_tpu.data.toy import ToyCorpus
+    from dnn_page_vectors_tpu.index.ivf import IVFIndex
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    from dnn_page_vectors_tpu.updates import append_corpus
+    _, cfg, trainer, emb, fstore = served
+    # a fresh store + index: appends must not disturb the shared fixture
+    dstore = VectorStore(str(tmp_path / "store"), dim=cfg.model.out_dim,
+                         shard_size=100)
+    dstore.ensure_model_step(fstore.model_step)   # appends check the stamp
+    emb.embed_corpus(trainer.corpus, dstore)
+    IVFIndex.build(dstore, emb.mesh, seed=0)
+    big = ToyCorpus(num_pages=340, seed=trainer.corpus.seed,
+                    num_topics=trainer.corpus.num_topics,
+                    page_len=trainer.corpus.page_len,
+                    query_len=trainer.corpus.query_len,
+                    languages=trainer.corpus.languages)
+    acfg = _cfg_with(cfg, serve={"index": "ivf"},
+                     obs={"window_s": 3.0})
+    svc = SearchService(acfg, emb, big, dstore, preload_hbm_gb=4.0)
+    assert svc._index is not None
+    svc.start_batcher()
+    grown = {"n": 300}
+
+    def _mutate():
+        grown["n"] += 12                  # ~36/336 appended: under the
+        c2 = ToyCorpus(num_pages=grown["n"], seed=big.seed,  # drift trigger
+                       num_topics=big.num_topics, page_len=big.page_len,
+                       query_len=big.query_len, languages=big.languages)
+        append_corpus(emb, c2, dstore)
+        svc.refresh()
+
+    wl = make_workload("poisson", seed=2, distinct=16)
+    queries = [big.query_text(i) for i in range(16)]
+    mut = Mutator(_mutate, period_s=0.9)
+    try:
+        tr = run_trial(svc, wl, 25.0, queries, duration_s=2.2,
+                       warmup_s=0.0, workers=4, mutator=mut)
+    finally:
+        svc.close()
+    assert tr["mutator_calls"] >= 1
+    assert not mut.errors, mut.errors
+    assert tr["errors"] == 0
+    assert tr["full_rebuilds"] == 0       # incremental updates only
+    names = [e["event"] for e in tr["events"]]
+    assert "view_swap" in names
+    assert svc.incremental_updates >= 1
+    assert dstore.num_vectors > 300       # the appends really landed

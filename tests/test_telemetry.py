@@ -272,6 +272,30 @@ def test_documented_obs_knobs_match_config():
         f"{fields - documented}")
 
 
+def test_emitted_event_names_are_documented():
+    """Every lifecycle event name emitted through `registry.event(...)`
+    anywhere in the package appears (backticked) in the
+    docs/OBSERVABILITY.md event table — a new PR cannot add a silent
+    event; conversely every documented name is really emitted somewhere,
+    so the table never advertises dead events."""
+    import glob
+    doc = open(os.path.join(_REPO, "docs", "OBSERVABILITY.md")).read()
+    emitted = set()
+    pkg = os.path.join(_REPO, "dnn_page_vectors_tpu")
+    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
+        emitted |= set(re.findall(r"\.event\(\s*[\"']([a-z_]+)[\"']",
+                                  open(path).read()))
+    assert len(emitted) >= 10, f"event-regex drift? found only {emitted}"
+    # table rows start "| `event_name` |" — dotted knob names, knob
+    # defaults mid-row, and the CamelCase instrument table don't match
+    documented = set(re.findall(r"^\|\s*`([a-z_]+)`", doc, re.M))
+    assert emitted <= documented, (
+        f"events emitted in code but missing from the "
+        f"docs/OBSERVABILITY.md event table: {sorted(emitted - documented)}")
+    assert documented <= emitted, (
+        f"documented but never emitted: {sorted(documented - emitted)}")
+
+
 def test_obs_config_round_trips_through_overrides():
     cfg = get_config("cdssm_toy", {"obs.slow_ms": "5.5",
                                    "obs.enabled": "false",
