@@ -97,7 +97,8 @@ def _previous_bench_record() -> dict | None:
 # cache hit rate) regresses by dropping. Ratio-vs-previous keys and
 # metadata are excluded: they re-derive from the gated keys anyway.
 _GATE_SKIP = {"vs_baseline", "attempts", "slo_p99_target_ms"}
-_LOWER_IS_BETTER = ("_ms", "seconds", "imbalance", "error", "_bytes")
+_LOWER_IS_BETTER = ("_ms", "seconds", "imbalance", "error", "_bytes",
+                    "lint_")
 
 
 def _lower_is_better(key: str) -> bool:
@@ -359,6 +360,18 @@ def run_worker() -> None:
         "fault_counters": faults.counters(),
         "degraded": bool(faults.counters()),
     }
+    # graftcheck counts ride the bench record (docs/ANALYSIS.md): the
+    # "lint_" keys are lower-is-better, so the regression gate flags
+    # suppression growth exactly like a latency regression — a PR cannot
+    # quietly pragma its way past the analyzer. AST-only, <1 s.
+    try:
+        from dnn_page_vectors_tpu.tools.analyze import analyze as _lint
+        _lint_report = _lint()
+        rec["lint_findings"] = len(_lint_report.findings)
+        rec["lint_suppressions"] = len(_lint_report.suppressed)
+        rec["lint_baselined"] = len(_lint_report.baselined)
+    except Exception as e:   # the analyzer must never cost a bench round
+        rec["lint_error"] = f"{type(e).__name__}: {e}"[:300]
     # The REQUIRED metrics are safe from this point: print them before the
     # optional sweeps, and again merged with their fields on success — the
     # wrapper parses the LAST record, and a sweep crash or per-attempt

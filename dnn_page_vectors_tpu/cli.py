@@ -18,6 +18,8 @@
   python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy --watch 2
   python -m dnn_page_vectors_tpu.cli loadtest --config cdssm_toy \
       --shape poisson --p99-ms 50 --seed 0
+  python -m dnn_page_vectors_tpu.cli lint
+  python -m dnn_page_vectors_tpu.cli lint --write-baseline
 
 Any config field is overridable with --set section.field=value; every flag
 round-trips through the Config dataclasses (SURVEY.md §5.6).
@@ -113,7 +115,18 @@ def main(argv=None) -> None:
                                         "init-store", "merge-store",
                                         "reset-store", "index", "append",
                                         "refresh", "trace",
-                                        "serve-metrics", "loadtest"])
+                                        "serve-metrics", "loadtest",
+                                        "lint"])
+    # -- lint (graftcheck, docs/ANALYSIS.md) -------------------------------
+    ap.add_argument("--root", default=None, metavar="DIR",
+                    help="lint: project root to analyze (default: this "
+                         "checkout) — used by fixture tests")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="lint: baseline file (default: "
+                         "<root>/.graftcheck-baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="lint: accept every current finding into the "
+                         "baseline file and exit 0")
     ap.add_argument("--tombstone", default=None, metavar="IDS",
                     help="append: comma-separated page ids to DELETE (their "
                          "vectors mask out of every retrieval path)")
@@ -204,6 +217,36 @@ def main(argv=None) -> None:
     if args.command == "configs":
         for name in sorted(CONFIGS):
             print(name)
+        return
+
+    if args.command == "lint":
+        # graftcheck static analysis (docs/ANALYSIS.md). Dispatches before
+        # any model/device/jax import on purpose: the analyzer is
+        # stdlib-only and must run on a jax-less box. JSON report on
+        # stdout, `file:line` diagnostics on stderr, exit 1 on any
+        # non-baselined finding.
+        import sys
+
+        from dnn_page_vectors_tpu.tools import analyze as graftcheck
+        root = args.root or graftcheck.REPO_ROOT
+        baseline = args.baseline or os.path.join(root,
+                                                 graftcheck.BASELINE_NAME)
+        report = graftcheck.analyze(root=root, baseline_path=baseline)
+        if args.write_baseline:
+            graftcheck.write_baseline(
+                baseline, report.findings + report.baselined)
+            print(json.dumps({"baseline": baseline,
+                              "entries": len(report.findings)
+                              + len(report.baselined)}))
+            return
+        for f in report.findings:
+            print(f.human(), file=sys.stderr)
+        for key in report.stale_baseline:
+            print(f"stale baseline entry (fixed? remove it): {key}",
+                  file=sys.stderr)
+        print(json.dumps(report.to_dict(), sort_keys=True))
+        if report.exit_code:
+            raise SystemExit(report.exit_code)
         return
     if args.command == "search" and not (args.query or args.queries
                                          or args.interactive):

@@ -190,6 +190,8 @@ class _MicroBatcher:
                 # finished child stamped onto the REQUEST's tree: how long
                 # this request sat in the queue before its dispatch
                 ctx.child("queue_wait", now - t0, t0=t0)
+        # graftcheck: off=locks -- single-writer: only the dispatcher
+        # thread appends; readers consume after stop() joins the thread
         self.batch_sizes.append(len(batch))
         by_key: Dict[tuple, list] = {}
         for query, key, fut, _, ctx in batch:
@@ -402,12 +404,13 @@ class SearchService:
         # first-seen key means XLA compiles — the classic hidden p99
         # cliff an SLO trial would otherwise misattribute to load
         self._m_recompiles = reg.counter("serve.recompiles")
-        self._compiled_keys: set = set()
+        self._compiled_keys: set = set()   # guarded-by: _compiled_lock
         self._compiled_lock = threading.Lock()
         # LRU query-embedding cache: normalized text + the store's model
         # step -> host fp32 query vector. Step in the KEY means a store
         # re-stamp (ensure_model_step) invalidates without a flush.
         serve_cfg = getattr(cfg, "serve", None)
+        # guarded-by: _cache_lock
         self._cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
         self._cache_cap = (serve_cfg.query_cache_size
                            if serve_cfg is not None else 0)
@@ -1327,6 +1330,7 @@ class SearchService:
             out.extend(self._collect_bucket(view, nreal, q, packed, k))
         return out
 
+    # graftcheck: hot
     def _dispatch_bucket(self, view: "_ServeView", qblock: np.ndarray,
                          k: int):
         """HBM-resident fast path for ONE compiled bucket (<= query_batch
@@ -1357,10 +1361,13 @@ class SearchService:
             packed = view.merge(cands)                 # async, on device
         return nreal, q, packed
 
+    # graftcheck: hot
     def _collect_bucket(self, view: "_ServeView", nreal: int, q, packed,
                         k: int) -> List[List[Dict]]:
         with self._stage("merge"):
-            packed = np.asarray(packed)                # the one transfer
+            # graftcheck: off=host-sync -- THE one packed d2h per
+            # bucket: the whole point of the merged [B, 2k] layout
+            packed = np.asarray(packed)
         top_s = np.ascontiguousarray(packed[:, :k]).view(np.float32)
         top_i = packed[:, k:]
         pids = np.where(top_i >= 0,
@@ -1381,6 +1388,8 @@ class SearchService:
         def _load_tail():
             for entry in view.stream_entries:
                 ids, vecs, scl = view.store._load_entry(entry, raw=True)
+                # graftcheck: off=host-sync -- mmap'd host arrays
+                # (degraded tail reads disk, no device involved)
                 yield np.asarray(ids, np.int64), np.asarray(vecs), scl
 
         with self._stage("topk", path="degraded_tail",

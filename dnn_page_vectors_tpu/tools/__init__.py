@@ -1,0 +1,1 @@
+"""Developer tooling that ships inside the package (stdlib-only)."""

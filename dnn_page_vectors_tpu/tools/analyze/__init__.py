@@ -1,0 +1,20 @@
+"""graftcheck — project-invariant static analysis (docs/ANALYSIS.md).
+
+    python -m dnn_page_vectors_tpu.cli lint            # JSON report, rc!=0
+    python -m dnn_page_vectors_tpu.cli lint --write-baseline
+
+Five rule families turn the repo's load-bearing conventions into
+machine-checked rules: determinism (seeded RNG / no wall clock on
+byte-pinned paths), lock discipline (`# guarded-by:` annotations), jit
+purity + host-sync hygiene, manifest-mediated file I/O, and doc/knob/
+marker drift. Stdlib-only: runs without jax installed.
+"""
+from dnn_page_vectors_tpu.tools.analyze.core import (  # noqa: F401
+    BASELINE_NAME, REPO_ROOT, RULES, FileContext, Finding, ProjectContext,
+    Report, Rule, analyze, analyze_source, load_baseline, write_baseline)
+
+# importing the rule modules registers every rule with the registry
+from dnn_page_vectors_tpu.tools.analyze import (  # noqa: F401,E402
+    rules_determinism, rules_drift, rules_io, rules_jit, rules_locks)
+
+RULE_FAMILIES = sorted({r.family for r in RULES.values()})

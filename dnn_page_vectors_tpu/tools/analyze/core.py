@@ -1,0 +1,508 @@
+"""graftcheck core: the rule registry, pragma/baseline machinery, and the
+analysis runner (docs/ANALYSIS.md).
+
+The analyzer is stdlib-only (`ast` + `tokenize`) on purpose: `cli lint` and
+the tier-1 `lint`-marked tests must run on a box with no jax/numpy installed,
+so the project invariants stay enforceable everywhere the source checks out.
+
+Vocabulary:
+  * A *rule* inspects one parsed file (`Rule.check`) or the whole project
+    tree (`Rule.project = True`, `Rule.check_project`) and yields `Finding`s.
+  * A *pragma* is an in-source suppression comment:
+        # graftcheck: off=rule-a,rule-b -- <mandatory reason>
+    On a code line it suppresses that line; on a comment-only line ABOVE
+    the module's first statement it suppresses the whole file; on any
+    other comment-only line it suppresses the next code line. `off`
+    without `=rules` covers every rule. A pragma WITHOUT a reason
+    suppresses nothing and is itself reported (rule `pragma`), so
+    silence always carries a justification.
+        # graftcheck: hot
+    on a `def` line marks a serving/train hot loop for the host-sync rule.
+  * The *baseline* is a JSON file of accepted pre-existing findings keyed on
+    (rule, path, stripped source line) — line-number free, so renumbering a
+    file never invalidates it. Baselined findings don't fail the run; keys
+    that no longer match anything are reported as stale so the file only
+    ever shrinks.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+# tools/analyze/core.py -> tools/analyze -> tools -> package -> repo root
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+PKG_NAME = os.path.basename(PKG_ROOT)
+BASELINE_NAME = ".graftcheck-baseline.json"
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftcheck:\s*(off|hot)\b(?:=([\w,-]+))?(?:\s*--\s*(\S.*))?")
+# the marker may trail prose inside the comment ("# (ts, value) pairs;
+# guarded-by: _lock") but must live in a comment, not a docstring
+GUARDED_BY_RE = re.compile(r"#.*?\bguarded-by:\s*(?:self\.)?([A-Za-z_]\w*)")
+HOLDS_LOCK_RE = re.compile(r"#.*?\bholds-lock:\s*(?:self\.)?([A-Za-z_]\w*)")
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative, posix separators
+    line: int
+    col: int
+    msg: str
+    snippet: str = ""    # stripped source line; the line-number-free half
+                         # of the baseline key
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.snippet}"
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.msg}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    kind: str                        # "off" | "hot"
+    rules: Optional[Tuple[str, ...]]  # None = every rule
+    reason: str
+    line: int
+    file_scope: bool
+
+    def covers(self, rule: str) -> bool:
+        return self.rules is None or rule in self.rules
+
+
+# ---------------------------------------------------------------------------
+# per-file context
+# ---------------------------------------------------------------------------
+
+def _collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> dotted import path, e.g. {"np": "numpy",
+    "jit": "jax.jit"}. Names never imported resolve to themselves so
+    un-aliased module-style chains (`time.time`) still qualify."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def qualname(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Resolve a Name/Attribute chain to a dotted path through the file's
+    imports; None for anything rooted in an expression (calls, subscripts,
+    `self.x`, ...)."""
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Attribute):
+        base = qualname(node.value, aliases)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+class FileContext:
+    """One parsed source file handed to every in-scope file rule."""
+
+    def __init__(self, relpath: str, source: str):
+        self.path = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.aliases = _collect_aliases(self.tree)
+        self.pragmas = self._resolve_pragmas(parse_pragmas(source))
+
+    def _resolve_pragmas(self, raw: List["Pragma"]) -> List["Pragma"]:
+        """Comment-only `off` pragmas above the first statement keep file
+        scope; later ones re-anchor to the next code line (the
+        disable-next-line idiom, so long lines need no trailing tag)."""
+        body = self.tree.body
+        first_code = body[0].lineno if body else len(self.lines) + 1
+        if (body and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            first_code = (body[1].lineno if len(body) > 1
+                          else len(self.lines) + 1)
+        out = []
+        for p in raw:
+            if (p.kind == "off" and p.file_scope
+                    and p.line >= first_code):
+                target = p.line
+                for i in range(p.line, len(self.lines)):
+                    text = self.lines[i].strip()
+                    if text and not text.startswith("#"):
+                        target = i + 1
+                        break
+                p = dataclasses.replace(p, file_scope=False, line=target)
+            out.append(p)
+        return out
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node_or_line, msg: str) -> Finding:
+        if isinstance(node_or_line, int):
+            line, col = node_or_line, 0
+        else:
+            line, col = node_or_line.lineno, node_or_line.col_offset
+        return Finding(rule, self.path, line, col, msg, self.snippet(line))
+
+    def is_hot(self, fn: ast.AST) -> bool:
+        """True when the def's signature lines — or the comment line
+        directly above the def — carry `# graftcheck: hot`."""
+        body_start = fn.body[0].lineno if getattr(fn, "body", None) else (
+            fn.lineno + 1)
+        hot = {p.line for p in self.pragmas if p.kind == "hot"}
+        return any(ln in hot
+                   for ln in range(fn.lineno - 1, body_start + 1))
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        """Lock name from a `# guarded-by: <lock>` comment on this line."""
+        m = GUARDED_BY_RE.search(self.snippet(line))
+        return m.group(1) if m else None
+
+    def holds_lock(self, fn: ast.AST) -> frozenset:
+        """Locks a `# holds-lock: <lock>` comment on the def's signature
+        lines (or the line above) asserts every caller already holds —
+        the called-with-lock-held helper contract."""
+        body_start = fn.body[0].lineno if getattr(fn, "body", None) else (
+            fn.lineno + 1)
+        locks = set()
+        for ln in range(fn.lineno - 1, body_start + 1):
+            m = HOLDS_LOCK_RE.search(self.snippet(ln))
+            if m:
+                locks.add(m.group(1))
+        return frozenset(locks)
+
+
+def parse_pragmas(source: str) -> List[Pragma]:
+    pragmas: List[Pragma] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):  # half-written fixture
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        kind, rules, reason = m.group(1), m.group(2), m.group(3)
+        code_prefix = tok.line[:tok.start[1]].strip()
+        pragmas.append(Pragma(
+            kind=kind,
+            rules=tuple(r for r in rules.split(",") if r) if rules else None,
+            reason=(reason or "").strip(),
+            line=tok.start[0],
+            file_scope=not code_prefix))
+    return pragmas
+
+
+# ---------------------------------------------------------------------------
+# project context (drift rules read config/docs/pytest.ini, not one file)
+# ---------------------------------------------------------------------------
+
+class ProjectContext:
+    def __init__(self, root: str, pkg: str = PKG_NAME):
+        self.root = root
+        self.pkg = pkg
+        self._cache: Dict[str, Optional[str]] = {}
+
+    def read(self, relpath: str) -> Optional[str]:
+        if relpath not in self._cache:
+            path = os.path.join(self.root, relpath)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._cache[relpath] = f.read()
+            except (OSError, UnicodeDecodeError):
+                self._cache[relpath] = None
+        return self._cache[relpath]
+
+    def glob(self, reldir: str, suffix: str) -> List[str]:
+        out: List[str] = []
+        base = os.path.join(self.root, reldir)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(suffix):
+                    rel = os.path.relpath(os.path.join(dirpath, name),
+                                          self.root)
+                    out.append(rel.replace(os.sep, "/"))
+        return out
+
+    def finding(self, rule: str, relpath: str, line: int, msg: str,
+                snippet: str = "") -> Finding:
+        if not snippet:
+            text = self.read(relpath)
+            if text:
+                lines = text.splitlines()
+                if 1 <= line <= len(lines):
+                    snippet = lines[line - 1].strip()
+        return Finding(rule, relpath.replace(os.sep, "/"), line, 0, msg,
+                       snippet)
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    name: str = ""
+    family: str = ""          # determinism | locks | jit | io | drift | meta
+    doc: str = ""
+    # path prefixes (dirs end with "/") or exact repo-relative files this
+    # rule inspects; None = every package file (file rules) / n.a. (project)
+    scope: Optional[Tuple[str, ...]] = None
+    project: bool = False
+
+    def in_scope(self, relpath: str) -> bool:
+        if self.scope is None:
+            return True
+        return any(relpath == s or (s.endswith("/") and relpath.startswith(s))
+                   for s in self.scope)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, ctx: ProjectContext) -> Iterator[Finding]:
+        return iter(())
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    rule = cls()
+    assert rule.name and rule.name not in RULES, rule.name
+    RULES[rule.name] = rule
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, Dict[str, object]]:
+    """Baseline key -> entry. Missing file = empty baseline."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    out: Dict[str, Dict[str, object]] = {}
+    for entry in data.get("entries", []):
+        key = f"{entry['rule']}::{entry['path']}::{entry.get('snippet', '')}"
+        out[key] = entry
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "snippet": f.snippet}
+         for f in findings),
+        key=lambda e: (e["rule"], e["path"], e["snippet"]))
+    # dedupe identical keys (several hits on one line collapse to one entry)
+    seen, unique = set(), []
+    for e in entries:
+        k = (e["rule"], e["path"], e["snippet"])
+        if k not in seen:
+            seen.add(k)
+            unique.append(e)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": unique}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]                   # active: fail the run
+    suppressed: List[Dict[str, object]]       # pragma'd, with reasons
+    baselined: List[Finding]
+    stale_baseline: List[str]
+    files_scanned: int
+    rules_run: List[str]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": self.suppressed,
+            "baselined": [f.to_dict() for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+            "counts": {
+                "findings": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+                "stale_baseline": len(self.stale_baseline),
+            },
+            "files_scanned": self.files_scanned,
+            "rules": self.rules_run,
+            "exit_code": self.exit_code,
+        }
+
+
+def iter_package_files(root: str, pkg: str = PKG_NAME) -> List[str]:
+    ctx = ProjectContext(root, pkg)
+    return ctx.glob(pkg, ".py")
+
+
+def _pragma_findings(ctx_pragmas: Dict[str, List[Pragma]],
+                     paths_with_source: Dict[str, FileContext]) -> List[Finding]:
+    out: List[Finding] = []
+    for path, pragmas in ctx_pragmas.items():
+        fctx = paths_with_source.get(path)
+        for p in pragmas:
+            if p.kind == "off" and not p.reason:
+                snippet = fctx.snippet(p.line) if fctx else ""
+                out.append(Finding(
+                    "pragma", path, p.line, 0,
+                    "graftcheck suppression without a reason — append "
+                    "`-- <why this is safe>`",
+                    snippet))
+    return out
+
+
+def analyze(root: Optional[str] = None,
+            rules: Optional[Iterable[str]] = None,
+            baseline_path: Optional[str] = None,
+            pkg: str = PKG_NAME) -> Report:
+    """Run the registry over `<root>/<pkg>` plus the project-level rules.
+
+    `rules` restricts to a subset of rule names (default: all). The
+    baseline defaults to `<root>/.graftcheck-baseline.json`.
+    """
+    root = root or REPO_ROOT
+    if baseline_path is None:
+        baseline_path = os.path.join(root, BASELINE_NAME)
+    active_rules = [RULES[n] for n in (rules or sorted(RULES))]
+    proj = ProjectContext(root, pkg)
+
+    raw: List[Finding] = []
+    contexts: Dict[str, FileContext] = {}
+    files = iter_package_files(root, pkg)
+    for rel in files:
+        source = proj.read(rel)
+        if source is None:
+            continue
+        try:
+            fctx = FileContext(rel, source)
+        except SyntaxError as e:
+            raw.append(Finding("parse", rel, e.lineno or 0, 0,
+                               f"syntax error: {e.msg}"))
+            continue
+        contexts[rel] = fctx
+        for rule in active_rules:
+            if rule.project or not rule.in_scope(rel):
+                continue
+            raw.extend(rule.check(fctx))
+    for rule in active_rules:
+        if rule.project:
+            raw.extend(rule.check_project(proj))
+
+    # pragma application: findings on a .py file consult that file's pragmas
+    pragmas_by_path: Dict[str, List[Pragma]] = {
+        p: c.pragmas for p, c in contexts.items()}
+    for f in raw:
+        # project rules may land findings on files outside the package
+        # sweep (tests/, config fixtures); parse their pragmas on demand
+        if f.path not in pragmas_by_path and f.path.endswith(".py"):
+            src = proj.read(f.path)
+            if src is not None:
+                try:
+                    fc = FileContext(f.path, src)
+                    contexts[f.path] = fc
+                    pragmas_by_path[f.path] = fc.pragmas
+                except SyntaxError:
+                    pragmas_by_path[f.path] = []
+
+    raw.extend(_pragma_findings(pragmas_by_path, contexts))
+
+    active: List[Finding] = []
+    suppressed: List[Dict[str, object]] = []
+    for f in raw:
+        reason = _suppression(f, pragmas_by_path.get(f.path, []))
+        if reason is not None:
+            d = f.to_dict()
+            d["reason"] = reason
+            suppressed.append(d)
+        else:
+            active.append(f)
+
+    baseline = load_baseline(baseline_path)
+    matched_keys = set()
+    final: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in active:
+        if f.key in baseline:
+            matched_keys.add(f.key)
+            baselined.append(f)
+        else:
+            final.append(f)
+    stale = sorted(set(baseline) - matched_keys)
+
+    final.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings=final, suppressed=suppressed, baselined=baselined,
+                  stale_baseline=stale, files_scanned=len(files),
+                  rules_run=[r.name for r in active_rules])
+
+
+def _suppression(f: Finding, pragmas: List[Pragma]) -> Optional[str]:
+    """Reason string when a reasoned `off` pragma covers this finding."""
+    if f.rule == "pragma":
+        return None          # the meta-rule cannot be pragma'd away
+    for p in pragmas:
+        if p.kind != "off" or not p.reason or not p.covers(f.rule):
+            continue
+        if p.file_scope or p.line == f.line:
+            return p.reason
+    return None
+
+
+def analyze_source(source: str, relpath: str,
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run file rules over one in-memory snippet at a virtual repo-relative
+    path (fixture tests); pragma semantics apply, baseline does not.
+    Pragma-without-reason findings are included."""
+    fctx = FileContext(relpath, source)
+    raw: List[Finding] = []
+    for name in (rules or sorted(RULES)):
+        rule = RULES[name]
+        if rule.project or not rule.in_scope(fctx.path):
+            continue
+        raw.extend(rule.check(fctx))
+    raw.extend(_pragma_findings({fctx.path: fctx.pragmas}, {fctx.path: fctx}))
+    out = []
+    for f in raw:
+        if _suppression(f, fctx.pragmas) is None:
+            out.append(f)
+    return sorted(out, key=lambda f: (f.line, f.rule))

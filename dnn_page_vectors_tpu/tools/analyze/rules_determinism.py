@@ -1,0 +1,91 @@
+"""Rule family 1 — determinism (docs/ANALYSIS.md).
+
+The byte-pinned paths (bulk embed, index build, PQ codebooks, live appends,
+the loadgen schedule) all promise "same seed == identical bytes"; their
+tests pin digests. Module-state RNG (`np.random.rand`, bare `random.*`),
+seedless RNG constructors, wall-clock reads, and PRNGKeys derived from the
+clock silently break that promise the day someone adds one — so they are
+findings anywhere under the pinned paths.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    FileContext, Finding, Rule, qualname, register, PKG_NAME)
+
+# np.random.<ctor>(seed) is the sanctioned spelling; the same ctor with NO
+# arguments falls back to OS entropy and is exactly the bug this rule hunts
+_RNG_CONSTRUCTORS = {"default_rng", "Generator", "SeedSequence", "PCG64",
+                     "Philox", "MT19937", "RandomState"}
+_STDLIB_SAMPLERS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "lognormvariate", "getrandbits", "randbytes", "seed"}
+_WALL_CLOCK = {
+    "time.time", "time.time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today"}
+_PRNG_KEY = {"jax.random.PRNGKey", "jax.random.key"}
+
+
+@register
+class DeterminismRule(Rule):
+    name = "determinism"
+    family = "determinism"
+    doc = ("unseeded/module-state RNG and wall-clock reads on the "
+           "byte-pinned embed/index/update/loadgen paths")
+    scope = (f"{PKG_NAME}/infer/", f"{PKG_NAME}/index/",
+             f"{PKG_NAME}/updates/", f"{PKG_NAME}/loadgen/workload.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualname(node.func, ctx.aliases)
+            if q is None:
+                continue
+            yield from self._check_call(ctx, node, q)
+
+    def _check_call(self, ctx: FileContext, node: ast.Call,
+                    q: str) -> Iterator[Finding]:
+        head, _, last = q.rpartition(".")
+        if head in ("numpy.random", "np.random"):
+            if last in _RNG_CONSTRUCTORS:
+                if not node.args and not node.keywords:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"seedless RNG constructor `{q}()` draws OS entropy "
+                        "— pass an explicit seed on a byte-pinned path")
+            else:
+                yield ctx.finding(
+                    self.name, node,
+                    f"module-state RNG `{q}(...)` is unseeded process "
+                    "state — use `np.random.default_rng(seed)`")
+        elif head == "random" and last in _STDLIB_SAMPLERS:
+            yield ctx.finding(
+                self.name, node,
+                f"stdlib module-state RNG `{q}(...)` — use a seeded "
+                "`random.Random(seed)` or `np.random.default_rng(seed)`")
+        elif q == "random.Random" and not node.args and not node.keywords:
+            yield ctx.finding(
+                self.name, node,
+                "seedless `random.Random()` draws OS entropy — pass an "
+                "explicit seed")
+        elif q in _WALL_CLOCK:
+            yield ctx.finding(
+                self.name, node,
+                f"wall-clock read `{q}()` on a byte-pinned path — derive "
+                "schedule/output bytes from the seed (perf_counter is fine "
+                "for measuring durations)")
+        elif q in _PRNG_KEY:
+            for arg in ast.walk(node):
+                if (isinstance(arg, ast.Call) and arg is not node
+                        and qualname(arg.func, ctx.aliases) in _WALL_CLOCK):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{q}` seeded from the wall clock — thread the "
+                        "config seed instead")
+                    break

@@ -1,0 +1,95 @@
+"""Rule family 4 — manifest-mediated file I/O (docs/ANALYSIS.md,
+docs/ROBUSTNESS.md).
+
+Every durable artifact in the store's blast radius (shards, posting lists,
+PQ codes, generation manifests, checkpoints) is written through one of the
+sanctioned writers — `write_shard` / `_atomic_dump` / a CRC-recording
+helper built on `crc_file` — so that bytes land with fsync, size+CRC enter
+a manifest, and the fault-injection hooks fire. A bare `open(..., "w")` or
+`np.save` in those paths produces a file the verify gate cannot vouch for:
+corruption hides until a reader trips over it.
+
+A write call is sanctioned when an enclosing function IS one of the
+sanctioned writers by name, or itself records a CRC (calls `crc_file`) —
+the `_write_npy` pattern in index/ivf.py.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    FileContext, Finding, Rule, qualname, register, PKG_NAME)
+
+_SANCTIONED_NAMES = {"write_shard", "_atomic_dump", "crc_file"}
+
+
+def _calls_crc_file(fn: ast.AST, aliases) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            q = qualname(node.func, aliases)
+            if q and q.split(".")[-1] == "crc_file":
+                return True
+    return False
+
+
+def _write_mode(call: ast.Call) -> str:
+    """The constant write mode of an open() call, or ''."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+            and "w" in mode.value:
+        return mode.value
+    return ""
+
+
+@register
+class ManifestIORule(Rule):
+    name = "manifest-io"
+    family = "io"
+    doc = ("bare open(...,'w')/np.save in store-adjacent write paths must "
+           "route through write_shard/_atomic_dump/crc_file")
+    scope = (f"{PKG_NAME}/index/", f"{PKG_NAME}/updates/",
+             f"{PKG_NAME}/train/checkpoint.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._scan(ctx, ctx.tree, sanctioned=False, stack=[])
+
+    def _scan(self, ctx: FileContext, node: ast.AST, sanctioned: bool,
+              stack: List[str]) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            ok = sanctioned
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ok = (sanctioned
+                      or child.name in _SANCTIONED_NAMES
+                      or _calls_crc_file(child, ctx.aliases))
+            if isinstance(child, ast.Call) and not ok:
+                yield from self._check_write(ctx, child)
+            yield from self._scan(ctx, child, ok, stack)
+
+    def _check_write(self, ctx: FileContext,
+                     call: ast.Call) -> Iterator[Finding]:
+        q = qualname(call.func, ctx.aliases)
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            mode = _write_mode(call)
+            if mode:
+                yield ctx.finding(
+                    self.name, call,
+                    f"bare `open(..., \"{mode}\")` writes an unmanifested "
+                    "file — route through write_shard/_atomic_dump so "
+                    "bytes+CRC land in a manifest with fsync")
+        elif q in ("numpy.save", "numpy.savez", "numpy.savez_compressed"):
+            yield ctx.finding(
+                self.name, call,
+                f"bare `{q}(...)` writes an unmanifested array — use the "
+                "CRC-recording writer pattern (`_write_npy`/`write_shard`)")
+        elif (isinstance(call.func, ast.Attribute)
+              and call.func.attr == "tofile"):
+            yield ctx.finding(
+                self.name, call,
+                "bare `.tofile(...)` writes unmanifested bytes — use the "
+                "CRC-recording writer pattern")

@@ -1,0 +1,144 @@
+"""Rule family 3 — jit purity and host-sync hygiene (docs/ANALYSIS.md).
+
+`jit-purity`: a function under `@jax.jit` / `shard_map` traces ONCE; Python
+side effects inside it (print, telemetry/registry calls, appending to
+captured lists) run at trace time only and then silently never again —
+a classic source of "my counter stopped moving" bugs. Flagged in the
+compiled-op homes (`ops/`, `index/`, `models/`).
+
+`host-sync`: functions annotated `# graftcheck: hot` (the serving dispatch
+and train-step inner loops) must not force a device->host sync per element
+— `.item()`, `np.asarray`, `jax.device_get`, `block_until_ready`, or
+`float(...)`/`int(...)` of an expression. A hot loop earns ONE packed
+transfer at the end; anything per-row is a latency cliff. Intended syncs
+carry a reasoned pragma so the contract stays visible in the diff.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    FileContext, Finding, Rule, qualname, register, PKG_NAME)
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_SHARD_NAMES = {"shard_map", "jax.experimental.shard_map.shard_map"}
+_SYNC_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_MUTATORS = {"append", "extend", "add", "update", "pop", "insert",
+             "setdefault", "remove", "clear"}
+
+
+def _is_jit_decorated(fn, aliases) -> Optional[str]:
+    """The decorator spelling when fn is jit/shard_map-compiled."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        q = qualname(target, aliases)
+        if q in _JIT_NAMES or (q and q.split(".")[-1] == "shard_map"):
+            return q
+        if q in ("functools.partial", "partial") and isinstance(dec, ast.Call):
+            if dec.args:
+                inner = qualname(dec.args[0], aliases)
+                if inner in _JIT_NAMES or (
+                        inner and inner.split(".")[-1] == "shard_map"):
+                    return f"partial({inner})"
+    return None
+
+
+def _local_names(fn) -> Set[str]:
+    names: Set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        names.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+    return names
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    family = "jit"
+    doc = ("Python side effects (print / registry events / captured-state "
+           "mutation) inside jit- or shard_map-compiled functions")
+    scope = (f"{PKG_NAME}/ops/", f"{PKG_NAME}/index/", f"{PKG_NAME}/models/")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            how = _is_jit_decorated(fn, ctx.aliases)
+            if how is None:
+                continue
+            local = _local_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "print"):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"print() inside `@{how}` runs at trace time only "
+                        "— use jax.debug.print or hoist to the host")
+                elif isinstance(node.func, ast.Attribute):
+                    q = qualname(node.func, ctx.aliases) or ""
+                    if node.func.attr == "event" or ".registry" in q or \
+                            q.startswith("registry."):
+                        yield ctx.finding(
+                            self.name, node,
+                            f"telemetry call inside `@{how}` fires once at "
+                            "trace time — emit from the host caller")
+                    elif (node.func.attr in _MUTATORS
+                          and isinstance(node.func.value, ast.Name)
+                          and node.func.value.id not in local):
+                        yield ctx.finding(
+                            self.name, node,
+                            f"`{node.func.value.id}.{node.func.attr}(...)` "
+                            f"mutates captured state inside `@{how}` — "
+                            "trace-time-only side effect")
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    family = "jit"
+    doc = ("per-element device->host syncs inside `# graftcheck: hot` "
+           "serving-dispatch / train-step loops")
+    scope = None        # fires only on annotated functions, package-wide
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not ctx.is_hot(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qualname(node.func, ctx.aliases)
+                if q in _SYNC_CALLS:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{q}(...)` in a hot loop forces a device sync — "
+                        "batch the transfer outside, or pragma with the "
+                        "reason it is the one packed d2h")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _SYNC_METHODS):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`.{node.func.attr}()` in a hot loop is a "
+                        "per-call device sync")
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in ("float", "int") and node.args
+                      and isinstance(node.args[0], (ast.Call, ast.Subscript,
+                                                    ast.Attribute))):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{node.func.id}(...)` of an expression in a hot "
+                        "loop blocks on the device if the value is an "
+                        "array — hoist or pragma with a reason")

@@ -1,0 +1,231 @@
+"""Rule family 2 — lock discipline (docs/ANALYSIS.md, docs/ROBUSTNESS.md).
+
+The serving stack is multithreaded in exactly three places (the micro-
+batcher dispatcher, SearchService.refresh, the telemetry registry), and its
+concurrency contract has two idioms:
+
+  * mutable shared state is annotated at its construction site with
+        self._cache = OrderedDict()   # guarded-by: _cache_lock
+    and may then only be touched inside `with self._cache_lock:`;
+  * immutable-view state is REPLACED, never mutated — the `_ServeView`
+    swap: `self._view = new_view` (whole-statement reference assignment)
+    and snapshot reads `view = self._view` are both atomic under the GIL
+    and need no lock.
+
+This rule machine-checks both: annotated attributes accessed outside their
+lock (except the two swap shapes) are findings, and a `threading.Thread`
+target method (plus the same-class methods it calls) mutating an
+UN-annotated attribute without any lock held is a finding too — new threads
+can't quietly grow unguarded shared state.
+
+A helper that is only ever called with the lock already held declares that
+contract on its def line: `# holds-lock: _lock` (the `_prune` idiom in
+utils/telemetry.py) — the scanner then treats the lock as held for the
+whole body, and the comment documents the calling convention for free.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    FileContext, Finding, Rule, qualname, register, PKG_NAME)
+
+_MUTATORS = {"append", "extend", "add", "update", "pop", "popitem", "remove",
+             "discard", "clear", "setdefault", "insert", "appendleft",
+             "popleft", "sort", "reverse"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.<name>` -> name, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "locks"
+    family = "locks"
+    doc = ("`# guarded-by:` attributes touched outside their lock; thread "
+           "targets mutating un-annotated shared state")
+    scope = (f"{PKG_NAME}/infer/serve.py", f"{PKG_NAME}/utils/telemetry.py",
+             f"{PKG_NAME}/updates/append.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    # -- per class ---------------------------------------------------------
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        guarded: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = _self_attr(node.targets[0])
+            elif isinstance(node, ast.AnnAssign):
+                target = _self_attr(node.target)
+            if target:
+                # the annotation rides the assignment line, or the comment
+                # line directly above it (79-col style)
+                lock = (ctx.guarded_by(node.lineno)
+                        or ctx.guarded_by(node.lineno - 1))
+                if lock:
+                    guarded[target] = lock
+
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        threaded = self._thread_reachable(ctx, cls, methods)
+
+        for name, fn in methods.items():
+            if name in ("__init__", "__new__"):
+                continue   # construction happens-before publication
+            yield from self._scan_stmts(
+                ctx, fn.body, ctx.holds_lock(fn), guarded,
+                thread_entry=(name in threaded))
+
+    def _thread_reachable(self, ctx: FileContext, cls: ast.ClassDef,
+                          methods: Dict[str, ast.AST]) -> Set[str]:
+        """Method names reachable from a `threading.Thread(target=...)`
+        started on this class (direct target + same-class call closure)."""
+        roots: List[str] = []
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            if qualname(node.func, ctx.aliases) != "threading.Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr and attr in methods:
+                        roots.append(attr)
+        reach: Set[str] = set()
+        frontier = list(roots)
+        while frontier:
+            name = frontier.pop()
+            if name in reach:
+                continue
+            reach.add(name)
+            for node in ast.walk(methods[name]):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee and callee in methods and callee not in reach:
+                        frontier.append(callee)
+        return reach
+
+    # -- the lock-context walker ------------------------------------------
+
+    def _scan_stmts(self, ctx, stmts, held, guarded,
+                    thread_entry: bool) -> Iterator[Finding]:
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                locks = set()
+                for item in st.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr:
+                        locks.add(attr)
+                yield from self._scan_stmts(ctx, st.body, held | locks,
+                                            guarded, thread_entry)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs later, on an unknown thread: it
+                # inherits NO held locks
+                yield from self._scan_stmts(ctx, st.body, frozenset(),
+                                            guarded, thread_entry)
+            elif isinstance(st, ast.ClassDef):
+                continue
+            else:
+                children = [f for f in ast.iter_fields(st)]
+                body_fields, expr_nodes = [], []
+                for fname, val in children:
+                    if isinstance(val, list) and val and isinstance(
+                            val[0], ast.stmt):
+                        body_fields.append(val)
+                    elif isinstance(val, list):
+                        for v in val:
+                            if not isinstance(v, ast.AST):
+                                continue
+                            # except-handler / match-case arms carry their
+                            # own statement bodies: recurse those so a
+                            # `with lock:` inside them still registers
+                            sub = getattr(v, "body", None)
+                            if (isinstance(sub, list) and sub
+                                    and isinstance(sub[0], ast.stmt)):
+                                body_fields.append(sub)
+                            else:
+                                expr_nodes.append(v)
+                    elif isinstance(val, ast.AST):
+                        expr_nodes.append(val)
+                if body_fields:
+                    # compound statement (if/for/while/try/match): check the
+                    # header expressions, then recurse into each body
+                    for expr in expr_nodes:
+                        yield from self._check_tree(ctx, expr, held, guarded,
+                                                    thread_entry, st)
+                    for body in body_fields:
+                        yield from self._scan_stmts(ctx, body, held, guarded,
+                                                    thread_entry)
+                else:
+                    yield from self._check_simple(ctx, st, held, guarded,
+                                                  thread_entry)
+
+    def _check_simple(self, ctx, st, held, guarded,
+                      thread_entry: bool) -> Iterator[Finding]:
+        allowed: Set[int] = set()
+        if isinstance(st, ast.Assign) and len(st.targets) == 1:
+            t = st.targets[0]
+            if _self_attr(t) in guarded:
+                allowed.add(id(t))       # atomic reference swap (store)
+            if (_self_attr(st.value) in guarded
+                    and all(isinstance(x, ast.Name) for x in st.targets)):
+                allowed.add(id(st.value))  # snapshot read of a swapped ref
+        yield from self._check_tree(ctx, st, held, guarded, thread_entry,
+                                    st, allowed)
+
+    def _check_tree(self, ctx, tree, held, guarded, thread_entry,
+                    stmt, allowed=frozenset()) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            attr = _self_attr(node)
+            if attr is not None and attr in guarded:
+                lock = guarded[attr]
+                if lock not in held and id(node) not in allowed:
+                    kind = ("write" if isinstance(node.ctx, (ast.Store,
+                                                             ast.Del))
+                            else "read")
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`self.{attr}` is `# guarded-by: {lock}` but this "
+                        f"{kind} holds {sorted(held) or 'no lock'} — wrap "
+                        f"in `with self.{lock}:` (or swap/snapshot the "
+                        "whole reference)")
+            if thread_entry and not held and isinstance(node, ast.Call):
+                target = _self_attr(getattr(node.func, "value", None))
+                if (target and target not in guarded
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"thread-reachable mutation `self.{target}"
+                        f".{node.func.attr}(...)` on an un-annotated "
+                        "attribute — annotate it `# guarded-by: <lock>` "
+                        "and lock the access, or pragma with the reason "
+                        "it is single-writer")
+            if thread_entry and not held:
+                store_attr = None
+                if isinstance(node, ast.AugAssign):
+                    store_attr = _self_attr(node.target) or _self_attr(
+                        getattr(node.target, "value", None))
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            store_attr = _self_attr(t.value)
+                if store_attr and store_attr not in guarded:
+                    yield ctx.finding(
+                        self.name, node,
+                        f"thread-reachable in-place write to un-annotated "
+                        f"`self.{store_attr}` — annotate it "
+                        "`# guarded-by: <lock>` and lock the access")

@@ -223,6 +223,7 @@ class Trainer:
         return self._compiled_multi
 
     # -- driver -----------------------------------------------------------
+    # graftcheck: hot
     def train(self, steps: Optional[int] = None,
               state: Optional[TrainState] = None,
               log: Optional[MetricsLogger] = None,
@@ -279,6 +280,7 @@ class Trainer:
             device_peak_flops, train_flops_per_pair)
         peak = device_peak_flops(self.mesh.devices.flat[0])
         flops_pair = train_flops_per_pair(cfg, cfg.train.batch_size)
+        # graftcheck: off=host-sync -- one-time sync before the loop
         start_step = int(state.step)
         prof = PipelineProfiler() if profiler is None else profiler
         # train-loop throughput as registry instruments (docs/
@@ -302,8 +304,12 @@ class Trainer:
             if i % cfg.train.log_every == 0 or i == steps:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 with prof.stage("sync"):
+                    # graftcheck: off=host-sync -- log-cadence drain:
+                    # fires every log_every steps, not per step
                     jax.block_until_ready(state.params)
                 dt = time.perf_counter() - t0
+                # graftcheck: off=host-sync -- after the log-cadence
+                # drain above; the value is already on host
                 done = int(state.step) - start_step
                 pps_chip = done * pages_per_step / dt / n_dev
                 metrics["pages_per_sec_per_chip"] = pps_chip
@@ -319,6 +325,7 @@ class Trainer:
                             stats["bytes_in_use"] / 2**30, 3)
                 except Exception:
                     pass
+                # graftcheck: off=host-sync -- post-drain host value
                 metrics["step"] = int(state.step)
                 # per-stage pipeline breakdown next to the rate it explains
                 metrics.update(prof.summary())
@@ -333,5 +340,6 @@ class Trainer:
             if (ckpt_manager is not None
                     and i % cfg.train.checkpoint_every == 0
                     and i < steps):      # final save is the caller's
+                # graftcheck: off=host-sync -- checkpoint-cadence sync
                 ckpt_manager.save(int(state.step), state)
         return state, last

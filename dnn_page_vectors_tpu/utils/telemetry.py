@@ -58,10 +58,10 @@ class Reservoir:
     def __init__(self, cap: int = 4096, seed: int = 0):
         import random
         self._cap = max(1, int(cap))
-        self._n = 0
-        self._sum = 0.0
-        self._buf: List[float] = []
-        self._rng = random.Random(seed)
+        self._n = 0                      # guarded-by: _lock
+        self._sum = 0.0                  # guarded-by: _lock
+        self._buf: List[float] = []      # guarded-by: _lock
+        self._rng = random.Random(seed)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def add(self, value: float) -> None:
@@ -121,8 +121,9 @@ class Counter:
         self.name = name
         self.window_s = float(window_s) if window_s else None
         self._clock = clock
-        self._v = 0
-        self._buckets: deque = deque()       # (bucket_start_ts, count)
+        self._v = 0                          # guarded-by: _lock
+        # (bucket_start_ts, count) ring — guarded-by: _lock
+        self._buckets: deque = deque()       # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
@@ -137,7 +138,7 @@ class Counter:
                     self._buckets.append([b, n])
                 self._prune(t)
 
-    def _prune(self, now: float) -> None:
+    def _prune(self, now: float) -> None:  # holds-lock: _lock
         horizon = now - self.window_s
         while self._buckets and self._buckets[0][0] < horizon:
             self._buckets.popleft()
@@ -169,7 +170,7 @@ class Gauge:
 
     def __init__(self, name: str):
         self.name = name
-        self._v = 0.0
+        self._v = 0.0                        # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
@@ -197,7 +198,8 @@ class Histogram:
         self.window_s = float(window_s) if window_s else None
         self._clock = clock
         self._res = Reservoir(cap=cap, seed=seed)
-        self._win: deque = deque(maxlen=max(1, int(cap)))   # (ts, value)
+        # (ts, value) pairs; guarded-by: _lock
+        self._win: deque = deque(maxlen=max(1, int(cap)))
         self._lock = threading.Lock()
 
     def observe(self, value: float, n: int = 1) -> None:
@@ -211,7 +213,7 @@ class Histogram:
                     self._win.append((t, v))
                 self._prune(t)
 
-    def _prune(self, now: float) -> None:
+    def _prune(self, now: float) -> None:  # holds-lock: _lock
         horizon = now - self.window_s
         while self._win and self._win[0][0] < horizon:
             self._win.popleft()
@@ -263,7 +265,8 @@ class MetricsRegistry:
     def __init__(self, events: int = 256,
                  clock: Callable[[], float] = time.monotonic):
         self._clock = clock
-        self._instruments: Dict[str, Any] = {}
+        self._instruments: Dict[str, Any] = {}   # guarded-by: _lock
+        # guarded-by: _lock
         self._events: deque = deque(maxlen=max(1, int(events)))
         self._lock = threading.Lock()
 

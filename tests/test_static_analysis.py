@@ -1,0 +1,524 @@
+"""graftcheck static-analysis tests (docs/ANALYSIS.md): the five rule
+families' true-positive/true-negative fixture matrix, pragma-suppression
+semantics (line vs file scope, missing-reason rejected), baseline
+add/expire behavior, the `cli lint` JSON report + exit codes, and the
+repo-is-clean tier-1 gate.
+
+Everything here is AST-only: no jax, no devices, no stores — the cli
+subprocess tests even strip JAX_PLATFORMS so the lint path is exercised
+exactly as it runs on a jax-less box.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dnn_page_vectors_tpu.tools.analyze import (
+    BASELINE_NAME, RULES, analyze, analyze_source, write_baseline)
+
+pytestmark = pytest.mark.lint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings, name=None):
+    return [f for f in findings if name is None or f.rule == name]
+
+
+# ---------------------------------------------------------------------------
+# family 1: determinism
+# ---------------------------------------------------------------------------
+
+_DET_POS = """
+import random
+import time
+import numpy as np
+import jax
+from datetime import datetime
+
+def bad():
+    a = np.random.rand(3)                 # module-state sampler
+    b = random.random()                   # stdlib module state
+    c = np.random.default_rng()           # seedless constructor
+    t = time.time()                       # wall clock
+    d = datetime.now()                    # wall clock
+    key = jax.random.PRNGKey(int(time.time()))   # clock-fed key
+    return a, b, c, t, d, key
+"""
+
+_DET_NEG = """
+import random
+import time
+import numpy as np
+import jax
+
+def good(seed: int):
+    rng = np.random.default_rng(seed)
+    r2 = random.Random(seed)
+    t = time.perf_counter()               # duration, not wall clock
+    key = jax.random.PRNGKey(seed)
+    return rng.random(), r2.random(), t, key
+"""
+
+
+def test_determinism_true_positives():
+    fs = _rules(analyze_source(
+        _DET_POS, "dnn_page_vectors_tpu/infer/fixture.py"), "determinism")
+    msgs = "\n".join(f.msg for f in fs)
+    # 7 findings on 6 lines: the clock-fed PRNGKey line is both a
+    # wall-clock read and a clock-seeded key
+    assert len(fs) == 7, msgs
+    assert "module-state RNG" in msgs
+    assert "stdlib module-state RNG" in msgs
+    assert "seedless RNG constructor" in msgs
+    assert "wall-clock read" in msgs
+    assert "seeded from the wall clock" in msgs
+
+
+def test_determinism_true_negatives():
+    assert not _rules(analyze_source(
+        _DET_NEG, "dnn_page_vectors_tpu/infer/fixture.py"), "determinism")
+
+
+def test_determinism_scope_is_byte_pinned_paths_only():
+    # the same sins OUTSIDE the pinned paths (e.g. train/) are not this
+    # rule's business
+    assert not _rules(analyze_source(
+        _DET_POS, "dnn_page_vectors_tpu/train/fixture.py"), "determinism")
+
+
+# ---------------------------------------------------------------------------
+# family 2: lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_SRC = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._cache = {}                  # guarded-by: _cache_lock
+        self._cache_lock = threading.Lock()
+        self._view = None                 # swapped, never mutated
+        self.sizes = []
+        self._t = threading.Thread(target=self._run)
+
+    def ok_locked(self, k, v):
+        with self._cache_lock:
+            self._cache[k] = v
+
+    def ok_swap(self):
+        self._cache = {}                  # whole-reference assignment
+
+    def ok_snapshot(self):
+        cache = self._cache               # snapshot read of the reference
+        return cache
+
+    def _evict(self):  # holds-lock: _cache_lock
+        self._cache.clear()
+
+    def bad_unlocked(self, k):
+        return self._cache[k]             # read outside the lock
+
+    def _run(self):
+        self.sizes.append(1)              # thread mutates un-annotated attr
+"""
+
+
+def test_locks_rule_matrix():
+    fs = _rules(analyze_source(
+        _LOCK_SRC, "dnn_page_vectors_tpu/infer/serve.py"), "locks")
+    lines = {f.line for f in fs}
+    assert len(fs) == 2, [f.human() for f in fs]
+    bad_read = next(f for f in fs if "read holds no lock" in f.msg)
+    assert "self._cache" in bad_read.msg and "_cache_lock" in bad_read.msg
+    thread_f = next(f for f in fs if "thread-reachable" in f.msg)
+    assert "sizes" in thread_f.msg
+    # the ok_* accesses, the holds-lock helper, and __init__ are all clean
+    assert all("ok_" not in (f.snippet or "") for f in fs), lines
+
+
+def test_locks_scope_is_the_three_threaded_files():
+    assert not _rules(analyze_source(
+        _LOCK_SRC, "dnn_page_vectors_tpu/infer/bulk_embed.py"), "locks")
+
+
+# ---------------------------------------------------------------------------
+# family 3: jit purity + host-sync
+# ---------------------------------------------------------------------------
+
+_JIT_SRC = """
+from functools import partial
+import jax
+
+TRACE_LOG = []
+
+@jax.jit
+def bad(x):
+    print("tracing", x)                  # trace-time-only side effect
+    TRACE_LOG.append(x)                  # captured-state mutation
+    return x * 2
+
+@partial(jax.jit, static_argnames=("k",))
+def also_jitted(x, k):
+    acc = []
+    acc.append(k)                        # local list: fine
+    return x[:k]
+
+def host_fn(x):
+    print("host side is allowed", x)
+    return x
+"""
+
+_HOT_SRC = """
+import numpy as np
+
+# graftcheck: hot
+def dispatch(dev_results):
+    out = [r.item() for r in dev_results]     # per-element sync
+    arr = np.asarray(dev_results)             # device pull
+    return out, arr
+
+def cold(dev_results):
+    return [r.item() for r in dev_results]    # not marked hot: fine
+"""
+
+
+def test_jit_purity_matrix():
+    fs = _rules(analyze_source(
+        _JIT_SRC, "dnn_page_vectors_tpu/ops/fixture.py"), "jit-purity")
+    msgs = "\n".join(f.msg for f in fs)
+    assert len(fs) == 2, msgs
+    assert "print()" in msgs and "mutates captured state" in msgs
+    # models/ and index/ are in scope too; train/ is not a compiled-op home
+    assert not _rules(analyze_source(
+        _JIT_SRC, "dnn_page_vectors_tpu/train/fixture.py"), "jit-purity")
+
+
+def test_host_sync_fires_only_on_hot_functions():
+    fs = _rules(analyze_source(
+        _HOT_SRC, "dnn_page_vectors_tpu/infer/fixture.py"), "host-sync")
+    assert len(fs) == 2, [f.human() for f in fs]
+    assert any(".item()" in f.msg for f in fs)
+    assert any("numpy.asarray" in f.msg for f in fs)
+    assert all(f.line < 10 for f in fs)       # nothing from cold()
+
+
+# ---------------------------------------------------------------------------
+# family 4: manifest I/O
+# ---------------------------------------------------------------------------
+
+_IO_SRC = """
+import json
+import os
+import numpy as np
+
+from dnn_page_vectors_tpu.infer.vector_store import crc_file
+
+def bad_write(path, obj):
+    with open(path, "w") as f:            # unmanifested write
+        json.dump(obj, f)
+
+def bad_save(path, arr):
+    np.save(path, arr)                    # unmanifested array
+
+def _atomic_dump(obj, path):
+    with open(path + ".tmp", "w") as f:   # the sanctioned writer itself
+        json.dump(obj, f)
+    os.replace(path + ".tmp", path)
+
+def crc_recorded_write(path, arr):
+    np.save(path, arr)                    # CRC recorded below: sanctioned
+    return os.path.getsize(path), crc_file(path)
+
+def reader(path):
+    with open(path) as f:                 # reads are nobody's business
+        return f.read()
+"""
+
+
+def test_manifest_io_matrix():
+    fs = _rules(analyze_source(
+        _IO_SRC, "dnn_page_vectors_tpu/index/fixture.py"), "manifest-io")
+    assert len(fs) == 2, [f.human() for f in fs]
+    assert any("open" in f.msg for f in fs)
+    assert any("numpy.save" in f.msg for f in fs)
+    # infer/ (vector_store's own home) is not in this rule's scope
+    assert not _rules(analyze_source(
+        _IO_SRC, "dnn_page_vectors_tpu/infer/fixture.py"), "manifest-io")
+
+
+# ---------------------------------------------------------------------------
+# family 5: drift (project rules on a mini tree)
+# ---------------------------------------------------------------------------
+
+_MINI_CONFIG = '''
+import dataclasses
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    nprobe: int = 8
+    mystery_knob: int = 3
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    name: str
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+'''
+
+_MINI_OBS_DOC = """# Observability
+
+Knobs: `serve.nprobe` steers probing. See also `serve.ghost_knob`.
+
+| event | meaning |
+|---|---|
+| `view_swap` | serving view hot-swapped |
+| `dead_event` | documented but never emitted |
+"""
+
+_MINI_EVENTS_PY = '''
+def fire(registry):
+    registry.event("view_swap")
+    registry.event("secret_event")
+'''
+
+_MINI_PYTEST_INI = """[pytest]
+markers =
+    slow: long tests
+    ghost: declared but never used
+"""
+
+_MINI_TEST_PY = """
+import pytest
+
+@pytest.mark.slow
+def test_a():
+    pass
+
+@pytest.mark.rogue
+def test_b():
+    pass
+"""
+
+
+def _mini_project(root, clean=False):
+    pkg = os.path.join(root, "dnn_page_vectors_tpu")
+    os.makedirs(pkg, exist_ok=True)
+    os.makedirs(os.path.join(root, "docs"), exist_ok=True)
+    os.makedirs(os.path.join(root, "tests"), exist_ok=True)
+    cfg = _MINI_CONFIG
+    obs = _MINI_OBS_DOC
+    events = _MINI_EVENTS_PY
+    ini = _MINI_PYTEST_INI
+    test_py = _MINI_TEST_PY
+    if clean:
+        cfg = cfg.replace("    mystery_knob: int = 3\n", "")
+        obs = (obs.replace("See also `serve.ghost_knob`.", "")
+                  .replace("| `dead_event` | documented but never emitted |\n",
+                           ""))
+        events = events.replace('    registry.event("secret_event")\n', "")
+        ini = ini.replace("    ghost: declared but never used\n", "")
+        test_py = test_py.replace(
+            "@pytest.mark.rogue\ndef test_b():\n    pass\n", "")
+    with open(os.path.join(pkg, "config.py"), "w") as f:
+        f.write(cfg)
+    with open(os.path.join(pkg, "telem.py"), "w") as f:
+        f.write(events)
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"), "w") as f:
+        f.write(obs)
+    with open(os.path.join(root, "pytest.ini"), "w") as f:
+        f.write(ini)
+    with open(os.path.join(root, "tests", "test_mini.py"), "w") as f:
+        f.write(test_py)
+    return root
+
+
+def test_drift_rules_mini_project(tmp_path):
+    root = _mini_project(str(tmp_path))
+    r = analyze(root=root)
+    by_rule = {}
+    for f in r.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    knob_msgs = "\n".join(f.msg for f in by_rule.get("drift-knobs", []))
+    assert "serve.mystery_knob" in knob_msgs          # undocumented knob
+    assert "serve.ghost_knob" in knob_msgs            # stale doc reference
+    ev_msgs = "\n".join(f.msg for f in by_rule.get("drift-events", []))
+    assert "secret_event" in ev_msgs                  # emitted, undocumented
+    assert "dead_event" in ev_msgs                    # documented, dead
+    mk_msgs = "\n".join(f.msg for f in by_rule.get("drift-markers", []))
+    assert "rogue" in mk_msgs                         # used, undeclared
+    assert "ghost" in mk_msgs                         # declared, unused
+    # and the `view_swap`/`slow`/`nprobe` matches stayed silent
+    for quiet in ("view_swap", "`slow`", "serve.nprobe"):
+        assert quiet not in knob_msgs + ev_msgs + mk_msgs
+
+
+def test_drift_rules_clean_mini_project(tmp_path):
+    root = _mini_project(str(tmp_path), clean=True)
+    r = analyze(root=root)
+    assert not r.findings, [f.human() for f in r.findings]
+
+
+# ---------------------------------------------------------------------------
+# pragma semantics
+# ---------------------------------------------------------------------------
+
+def test_pragma_inline_with_reason_suppresses():
+    src = ("import numpy as np\n"
+           "x = np.random.rand(3)  "
+           "# graftcheck: off=determinism -- fixture wants raw entropy\n")
+    fs = analyze_source(src, "dnn_page_vectors_tpu/infer/fixture.py")
+    assert not _rules(fs, "determinism")
+    assert not _rules(fs, "pragma")
+
+
+def test_pragma_without_reason_is_rejected_and_reported():
+    src = ("import numpy as np\n"
+           "x = np.random.rand(3)  # graftcheck: off=determinism\n")
+    fs = analyze_source(src, "dnn_page_vectors_tpu/infer/fixture.py")
+    assert _rules(fs, "determinism")       # NOT suppressed
+    assert _rules(fs, "pragma")            # and the naked pragma is flagged
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = ("import numpy as np\n"
+           "x = np.random.rand(3)  # graftcheck: off=locks -- wrong family\n")
+    fs = analyze_source(src, "dnn_page_vectors_tpu/infer/fixture.py")
+    assert _rules(fs, "determinism")
+
+
+def test_pragma_file_scope_at_top_of_file():
+    src = ("# graftcheck: off=determinism -- synthetic chaos fixture\n"
+           "import numpy as np\n"
+           "x = np.random.rand(3)\n"
+           "y = np.random.rand(4)\n")
+    fs = analyze_source(src, "dnn_page_vectors_tpu/infer/fixture.py")
+    assert not _rules(fs, "determinism")
+
+
+def test_pragma_standalone_mid_file_covers_next_code_line_only():
+    src = ("import numpy as np\n"
+           "# graftcheck: off=determinism -- seeded upstream of this call\n"
+           "x = np.random.rand(3)\n"
+           "y = np.random.rand(4)\n")
+    fs = _rules(analyze_source(
+        src, "dnn_page_vectors_tpu/infer/fixture.py"), "determinism")
+    assert len(fs) == 1 and fs[0].line == 4
+
+
+# ---------------------------------------------------------------------------
+# baseline add / expire
+# ---------------------------------------------------------------------------
+
+def test_baseline_add_and_expire(tmp_path):
+    root = _mini_project(str(tmp_path))
+    baseline = os.path.join(root, BASELINE_NAME)
+    first = analyze(root=root)
+    assert first.findings and first.exit_code == 1
+    write_baseline(baseline, first.findings)
+
+    second = analyze(root=root)             # same tree, accepted findings
+    assert not second.findings and second.exit_code == 0
+    assert len(second.baselined) == len(first.findings)
+    assert not second.stale_baseline
+
+    _mini_project(str(tmp_path), clean=True)  # everything fixed
+    third = analyze(root=root)
+    assert not third.findings and third.exit_code == 0
+    assert not third.baselined
+    assert third.stale_baseline              # entries now expired, listed
+
+
+# ---------------------------------------------------------------------------
+# cli lint: JSON report shape + exit codes (subprocess, no jax import)
+# ---------------------------------------------------------------------------
+
+def _run_lint(root):
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "dnn_page_vectors_tpu.cli", "lint",
+         "--root", root],
+        capture_output=True, text=True, env=env, timeout=120)
+
+
+def test_cli_lint_exits_nonzero_on_seeded_violation(tmp_path):
+    proc = _run_lint(_mini_project(str(tmp_path)))
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["exit_code"] == 1
+    assert report["counts"]["findings"] == len(report["findings"])
+    assert report["findings"], report
+    f = report["findings"][0]
+    assert set(f) >= {"rule", "path", "line", "col", "msg", "snippet"}
+    # human diagnostics ride stderr as file:line:col
+    assert ":" in proc.stderr.splitlines()[0]
+
+
+def test_cli_lint_exits_zero_on_clean_tree_and_after_write_baseline(tmp_path):
+    clean_root = _mini_project(str(tmp_path / "clean"), clean=True)
+    os.makedirs(clean_root, exist_ok=True)
+    proc = _run_lint(clean_root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["counts"]["findings"] == 0
+    assert sorted(report["rules"]) == sorted(RULES)
+
+    dirty_root = _mini_project(str(tmp_path / "dirty"))
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    wb = subprocess.run(
+        [sys.executable, "-m", "dnn_page_vectors_tpu.cli", "lint",
+         "--root", dirty_root, "--write-baseline"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert wb.returncode == 0, wb.stderr
+    assert json.loads(wb.stdout)["entries"] > 0
+    proc = _run_lint(dirty_root)             # baselined: now green
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["counts"]["baselined"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the repo itself is clean — the tier-1 gate behind `cli lint` exit 0
+# ---------------------------------------------------------------------------
+
+def test_repo_has_no_unsuppressed_findings():
+    r = analyze(root=_REPO)
+    assert not r.findings, "\n".join(f.human() for f in r.findings)
+    assert not r.stale_baseline, r.stale_baseline
+    # every suppression carries its reason (enforced by the pragma rule,
+    # double-checked here so the report stays honest)
+    assert all(s.get("reason") for s in r.suppressed)
+
+
+def test_analyzer_is_stdlib_only():
+    """The lint path must run on a jax-less box: no jax/numpy imports
+    anywhere under tools/analyze (the subprocess tests above strip
+    JAX_PLATFORMS, this pins the import graph itself)."""
+    import ast
+    adir = os.path.join(_REPO, "dnn_page_vectors_tpu", "tools", "analyze")
+    for name in os.listdir(adir):
+        if not name.endswith(".py"):
+            continue
+        tree = ast.parse(open(os.path.join(adir, name)).read())
+        for node in ast.walk(tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+            for m in mods:
+                root_mod = m.split(".")[0]
+                assert root_mod not in ("jax", "numpy", "jaxlib"), (
+                    f"{name} imports {m}")
+
+
+def test_rule_registry_documented():
+    """Every registered rule appears (backticked) in docs/ANALYSIS.md —
+    the analyzer eats its own drift dog food."""
+    doc = open(os.path.join(_REPO, "docs", "ANALYSIS.md")).read()
+    for name in RULES:
+        assert f"`{name}`" in doc, f"rule `{name}` missing from ANALYSIS.md"
+    families = {r.family for r in RULES.values()}
+    assert {"determinism", "locks", "jit", "io", "drift"} <= families
