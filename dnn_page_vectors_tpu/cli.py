@@ -13,6 +13,7 @@
   python -m dnn_page_vectors_tpu.cli append --config cdssm_toy \
       --set data.num_pages=12000 --tombstone 17,42
   python -m dnn_page_vectors_tpu.cli refresh --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli maintain --config cdssm_toy --once
   python -m dnn_page_vectors_tpu.cli trace --config cdssm_toy --query "..."
   python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy --watch 2
@@ -114,9 +115,13 @@ def main(argv=None) -> None:
                                         "search", "pipeline", "configs",
                                         "init-store", "merge-store",
                                         "reset-store", "index", "append",
-                                        "refresh", "trace",
+                                        "refresh", "maintain", "trace",
                                         "serve-metrics", "loadtest",
                                         "lint"])
+    ap.add_argument("--once", action="store_true",
+                    help="maintain: run ONE synchronous pass of every "
+                         "pillar (janitor, compaction, rebuild) and exit "
+                         "instead of looping every maintenance.interval_s")
     # -- lint (graftcheck, docs/ANALYSIS.md) -------------------------------
     ap.add_argument("--root", default=None, metavar="DIR",
                     help="lint: project root to analyze (default: this "
@@ -208,6 +213,13 @@ def main(argv=None) -> None:
                     help="loadtest: hot-swap refresh() every S seconds of "
                          "trial time — measures serving UNDER live "
                          "updates (docs/UPDATES.md)")
+    ap.add_argument("--mutate-mode", dest="mutate_mode", default="refresh",
+                    choices=["refresh", "maintain"],
+                    help="loadtest: what --mutate-every fires — 'refresh' "
+                         "(the plain hot-swap) or 'maintain' (alternate "
+                         "tombstones+refresh with a full maintenance pass: "
+                         "compaction + background index rebuilds under "
+                         "fire, docs/MAINTENANCE.md)")
     ap.add_argument("--faults", default=None, metavar="PLAN",
                     help="fault-injection plan 'op:kind:at[:count],...' "
                          "(utils/faults.py; shorthand for --set "
@@ -376,6 +388,51 @@ def main(argv=None) -> None:
             "nlist": idx.nlist, "imbalance": idx.imbalance,
             "index_generation": idx.index_generation,
             **info, "fault_counters": faults.counters()}, sort_keys=True))
+        return
+
+    if args.command == "maintain":
+        # Background maintenance (docs/MAINTENANCE.md): generation
+        # compaction once tombstone density crosses the threshold,
+        # off-path IVF rebuilds (drift or structural staleness), and the
+        # stale-artifact janitor. Needs no model — just the store and a
+        # device mesh for the rebuild's k-means. --once runs a single
+        # synchronous pass; without it the supervised workers poll every
+        # maintenance.interval_s until Ctrl-C, one JSON line per pass
+        # that did work.
+        import sys
+        import time as _time
+
+        from dnn_page_vectors_tpu.maintenance import MaintenanceService
+        from dnn_page_vectors_tpu.parallel.multihost import local_mesh
+        try:
+            store = VectorStore(store_dir)
+        except FileNotFoundError:
+            raise SystemExit(f"no store at {store_dir}; run 'embed' "
+                             "before maintaining")
+        ms = MaintenanceService(cfg, store.directory, local_mesh(cfg.mesh))
+        if args.once:
+            out = ms.run_once()
+            print(json.dumps({"store": store_dir, **out,
+                              "fault_counters": faults.counters()},
+                             sort_keys=True))
+            return
+        print(json.dumps({"maintaining": store_dir,
+                          "interval_s": cfg.maintenance.interval_s}),
+              file=sys.stderr, flush=True)
+        ms.start()     # the supervised worker pool: one thread per pillar
+        seen = {}
+        try:
+            while True:
+                _time.sleep(cfg.maintenance.interval_s)
+                snap = ms.stats()
+                for pillar, n in snap["passes"].items():
+                    if n != seen.get(pillar):
+                        seen[pillar] = n
+                        print(json.dumps(
+                            {pillar: snap["last"].get(pillar), "passes": n},
+                            sort_keys=True), flush=True)
+        except KeyboardInterrupt:
+            ms.close()
         return
 
     if args.command == "init-store":
@@ -657,8 +714,36 @@ def main(argv=None) -> None:
         queries = [trainer.corpus.query_text(i) for i in range(distinct)]
         wl = make_workload(args.shape, seed=args.seed, distinct=distinct,
                            profile=((k, None, 1.0),))
-        mut = (Mutator(svc.refresh, period_s=args.mutate_every)
-               if args.mutate_every else None)
+        maint = None
+        if args.mutate_every and args.mutate_mode == "maintain":
+            # maintenance under fire (docs/MAINTENANCE.md): alternate a
+            # tombstone burst + hot-swap refresh with a full maintenance
+            # pass, so the measured p99 covers compaction and background
+            # index rebuilds actually running — lower
+            # maintenance.compact_tombstone_density via --set to make
+            # compaction fire within a short test
+            from dnn_page_vectors_tpu.updates import append_corpus
+            maint = svc.start_maintenance(threads=False)
+            n_base = max(store.num_vectors, 1)
+            tomb_state = {"next": 0}
+            tomb_chunk = max(16, n_base // 64)
+
+            def _tombstone_refresh():
+                ids = sorted({(tomb_state["next"] + i) % n_base
+                              for i in range(tomb_chunk)})
+                tomb_state["next"] = (tomb_state["next"]
+                                      + tomb_chunk) % n_base
+                append_corpus(embedder, trainer.corpus, svc.store,
+                              tombstone=ids)
+                svc.refresh()
+
+            mut = Mutator(ops=[("tombstone_refresh", _tombstone_refresh),
+                               ("maintain", maint.run_once)],
+                          period_s=args.mutate_every)
+        elif args.mutate_every:
+            mut = Mutator(svc.refresh, period_s=args.mutate_every)
+        else:
+            mut = None
         trial_s = (args.trial_s if args.trial_s is not None
                    else cfg.obs.window_s)
         report = find_qps_at_p99(
@@ -667,6 +752,15 @@ def main(argv=None) -> None:
             warmup_s=args.warmup_s, mutator=mut,
             progress=lambda line: print(line, file=sys.stderr, flush=True),
             progress_every_s=max(1.0, trial_s / 2.0))
+        if maint is not None:
+            final_met = svc.metrics()
+            report.update({
+                "mutate_mode": args.mutate_mode,
+                "maintenance": maint.stats(),
+                "full_rebuilds": final_met["full_rebuilds"],
+                "tombstone_density": final_met["tombstone_density"],
+                "reclaimable_bytes": final_met["reclaimable_bytes"],
+            })
         svc.close()
         report.update({
             "store_vectors": store.num_vectors,

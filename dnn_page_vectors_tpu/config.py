@@ -252,6 +252,39 @@ class UpdatesConfig:
     # the threshold. metrics() reports restage_skipped/restage_forced.
     # 0.0 restores the exact-ids policy (any tombstone restages).
     restage_tombstone_density: float = 0.05
+    # Multi-writer append leases (docs/MAINTENANCE.md): append_corpus
+    # acquires a per-writer lease on the append cursor (lease file under
+    # the store manifest dir) before reading next_page_id(), so two
+    # concurrent `cli append` processes can never double-assign ids. The
+    # lease expires after this many seconds — a crashed writer's lease is
+    # stolen (lease_stolen event) instead of blocking appends forever.
+    writer_lease_s: float = 30.0
+    # How long a second writer QUEUES on a held lease before giving up
+    # (seconds). 0 fails fast (LeaseHeld) instead of waiting.
+    lease_wait_s: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MaintenanceConfig:
+    """Background maintenance service (dnn_page_vectors_tpu/maintenance/,
+    docs/MAINTENANCE.md): online generation compaction, off-path IVF
+    rebuilds, and the stale-artifact janitor — a store that ingests,
+    compacts, and re-indexes continuously while serving."""
+    # Compaction trigger: when the tombstone density across the generation
+    # chain (dead rows / total rows) crosses this, the background compactor
+    # folds the gen-NNNN chain plus the base into a fresh compacted base —
+    # dead rows dropped, ids preserved, one atomic manifest pointer flip.
+    compact_tombstone_density: float = 0.2
+    # Worker poll period (seconds): how often each pillar worker re-checks
+    # its trigger. `cli maintain --once` / run_once() ignore it.
+    interval_s: float = 5.0
+    # Move drift-triggered IVF full rebuilds OFF the refresh() caller: with
+    # a MaintenanceService attached, refresh() defers the rebuild (the
+    # incremental posting append still runs; serve.index_rebuild_pending
+    # flags it) and the background builder constructs the next index
+    # generation beside the live one, hot-swapping via refresh(). False
+    # keeps the PR-5 inline-rebuild behavior even with maintenance running.
+    bg_rebuild: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -311,6 +344,8 @@ class Config:
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     updates: UpdatesConfig = dataclasses.field(default_factory=UpdatesConfig)
+    maintenance: MaintenanceConfig = dataclasses.field(
+        default_factory=MaintenanceConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     workdir: str = "/tmp/dnn_page_vectors_tpu"

@@ -87,7 +87,12 @@ class IndexUnavailable(RuntimeError):
 
 
 def index_dir(store) -> str:
-    return os.path.join(store.directory, DIRNAME)
+    """The LIVE index directory: the store manifest's `index_dir` pointer
+    ("ivf" by default). A background rebuild (docs/MAINTENANCE.md) builds
+    the next index generation into a sibling dir and flips the pointer
+    atomically — readers never observe a half-written index."""
+    return os.path.join(store.directory,
+                        getattr(store, "index_dirname", DIRNAME))
 
 
 def auto_nlist(num_vectors: int) -> int:
@@ -306,7 +311,8 @@ class IVFIndex:
               sample_per_shard: Optional[int] = None,
               init: str = "kmeans++", balance: float = 0.0,
               pq_m: int = 0, pq_iters: int = 8,
-              opq_iters: int = 3) -> "IVFIndex":
+              opq_iters: int = 3,
+              dirname: Optional[str] = None) -> "IVFIndex":
         """Train the quantizer, assign every store row, and persist the
         inverted file next to the store (atomic manifest last, so a crash
         mid-build leaves the previous index or none — never a torn one
@@ -314,7 +320,12 @@ class IVFIndex:
         ceil(balance * N / nlist) rows during the assignment sweep
         (overflow spills to the row's next-best centroid — docs/ANN.md).
         `pq_m` > 0 additionally trains the OPQ+PQ codec (index/pq.py) and
-        persists m-byte codes per row for the ADC search path."""
+        persists m-byte codes per row for the ADC search path.
+
+        `dirname` builds into an explicit sibling directory instead of
+        the live pointer target — the background rebuilder's
+        build-beside-then-flip protocol (docs/MAINTENANCE.md); the
+        returned object should be re-opened after the pointer flip."""
         t0 = time.perf_counter()
         N = store.num_vectors
         if N == 0:
@@ -331,7 +342,8 @@ class IVFIndex:
         if pq_m:
             codec, pq_stats = train_pq(store, int(pq_m), iters=pq_iters,
                                        opq_iters=opq_iters, seed=seed)
-        d = index_dir(store)
+        d = (os.path.join(store.directory, dirname) if dirname
+             else index_dir(store))
         os.makedirs(d, exist_ok=True)
         cb, cc = _write_npy(os.path.join(d, "centroids.npy"), centroids)
         shards_meta, postings, sizes, sizes_raw = cls._assign_postings(
@@ -389,7 +401,8 @@ class IVFIndex:
     @classmethod
     def update(cls, store, mesh, rebuild_drift: float = 0.25,
                nlist: int = 0, iters: int = 8, seed: Optional[int] = None,
-               chunk: int = 8192, init: str = "kmeans++"
+               chunk: int = 8192, init: str = "kmeans++",
+               defer_rebuild: bool = False
                ) -> Tuple["IVFIndex", Dict]:
         """Bring the persisted index up to date with the store after an
         append: assign ONLY the shards the recorded table doesn't know to
@@ -416,13 +429,25 @@ class IVFIndex:
         posting append), and a drift rebuild retrains the codec with the
         recorded m/iters/opq settings. The balance factor is inherited
         the same way, though incremental appends assign new rows by
-        plain argmax — the cap re-applies at the next full rebuild."""
+        plain argmax — the cap re-applies at the next full rebuild.
+
+        `defer_rebuild` moves full rebuilds OFF this caller
+        (docs/MAINTENANCE.md): a pure-drift overrun still runs the O(new
+        shards) incremental append — new docs stay servable — and flags
+        `info["rebuild_pending"]` for the background builder; a
+        structural reason (missing/torn/stale index, changed shard table)
+        raises IndexUnavailable instead of rebuilding inline, so the
+        caller degrades to exact search, visibly, until the background
+        rebuild hot-swaps a fresh index generation in."""
         t0 = time.perf_counter()
         d = index_dir(store)
         mpath = os.path.join(d, MANIFEST)
 
         def _rebuild(reason: str, man: Optional[Dict] = None
                      ) -> Tuple["IVFIndex", Dict]:
+            if defer_rebuild:
+                raise IndexUnavailable(
+                    f"rebuild deferred to the background worker ({reason})")
             pq_cfg = (man or {}).get("pq") or {}
             idx = cls.build(store, mesh, nlist=nlist, iters=iters,
                             seed=0 if seed is None else seed, chunk=chunk,
@@ -471,9 +496,15 @@ class IVFIndex:
         appended = (int(man.get("appended_since_build", 0))
                     + sum(e["count"] for e in new_entries))
         drift = appended / max(total, 1)
+        rebuild_pending = False
         if drift > rebuild_drift:
-            return _rebuild(
-                f"drift {drift:.3f} > rebuild_drift {rebuild_drift}", man)
+            if not defer_rebuild:
+                return _rebuild(
+                    f"drift {drift:.3f} > rebuild_drift {rebuild_drift}",
+                    man)
+            # deferred: extend anyway (new docs must serve NOW; the stale
+            # centroids cost bounded recall until the background rebuild)
+            rebuild_pending = True
         centroids = np.asarray(
             np.load(os.path.join(d, man["centroids"]["file"])), np.float32)
         new_meta, _, new_sizes, _ = cls._assign_postings(
@@ -508,6 +539,7 @@ class IVFIndex:
                 {"action": "incremental", "new_shards": len(new_entries),
                  "appended_rows": sum(e["count"] for e in new_entries),
                  "drift": round(drift, 4),
+                 "rebuild_pending": rebuild_pending,
                  "index_generation": man["index_generation"],
                  "seconds": round(time.perf_counter() - t0, 3)})
 

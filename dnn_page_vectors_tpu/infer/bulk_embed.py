@@ -325,6 +325,17 @@ class BulkEmbedder:
         ``writer_id=start // shard_size`` (docs/SCALING.md recipe).
         """
         bs = batch_size or self.cfg.eval.embed_batch_size
+        if store.manifest.get("compacted_through"):
+            # a compacted base re-shards rows by id order under new shard
+            # indices (docs/MAINTENANCE.md): the index-based resume
+            # bookkeeping below would re-embed — and double-assign — the
+            # whole base range. Compaction only ever runs on a completed
+            # store, so a base sweep here is a caller error.
+            raise ValueError(
+                f"store at {store.directory} has been compacted (through "
+                f"generation {store.manifest['compacted_through']}); the "
+                "base embed is complete — append new pages with "
+                "append_corpus / `cli append` instead")
         shard_size = store.manifest["shard_size"]
         assert shard_size % bs == 0 or shard_size >= corpus.num_pages, (
             "shard_size must be a batch multiple for resumable sweeps")

@@ -315,7 +315,7 @@ class _ServeView:
     __slots__ = ("store", "entries", "generation", "shards", "shard_keys",
                  "stream_entries", "pid_table", "merge", "pad_rows",
                  "index", "index_error", "index_info", "docs_appended",
-                 "tombstoned", "num_vectors")
+                 "tombstoned", "num_vectors", "maint_stats")
 
     def __init__(self, store: VectorStore):
         self.store = store
@@ -324,6 +324,9 @@ class _ServeView:
         self.docs_appended = store.appended_vectors()
         self.tombstoned = store.tombstoned_count()
         self.num_vectors = store.num_vectors
+        # the compaction trigger's inputs, frozen with the chain they
+        # describe (docs/MAINTENANCE.md): density/dead-rows/reclaimable
+        self.maint_stats: Dict = store.maintenance_stats()
         self.shards = None   # [(ids np[int64], n, pages [R, D], scl|None)]
         self.shard_keys: List[tuple] = []
         self.stream_entries: List[Dict] = []
@@ -460,6 +463,15 @@ class SearchService:
                 on_change=self._on_window_adapt)
         self._batcher: Optional[_MicroBatcher] = None
         self._batch_sizes: List[int] = []   # telemetry after close()
+        # background maintenance (docs/MAINTENANCE.md): start_maintenance()
+        # attaches the service and — under maintenance.bg_rebuild — moves
+        # drift-triggered IVF full rebuilds off the refresh() caller onto
+        # its rebuild worker (refresh defers; the worker builds beside the
+        # live index and hot-swaps). Without the service attached, refresh
+        # keeps the inline-rebuild behavior.
+        self._maintenance = None
+        self._defer_rebuilds = False
+        reg.gauge("serve.index_rebuild_pending").set(0.0)
         self._log = log
         # Per-query encode is O(1 query), not the 512-row bulk-embed batch
         # wearing a serving hat (VERDICT r4 Weak #2): queries pad only to a
@@ -699,6 +711,15 @@ class SearchService:
     def _build_view(self, store: VectorStore, reuse: "_ServeView" = None,
                     update_index: bool = False) -> "_ServeView":
         view = _ServeView(store)
+        # dead-byte accounting as registry gauges (docs/MAINTENANCE.md):
+        # the compaction trigger's inputs ride the same exposition as
+        # every other serving number (metrics(), cli serve-metrics)
+        ms = view.maint_stats
+        self.registry.gauge("serve.tombstone_density").set(
+            ms["tombstone_density"])
+        self.registry.gauge("serve.dead_rows").set(ms["dead_rows"])
+        self.registry.gauge("serve.reclaimable_bytes").set(
+            ms["reclaimable_bytes"])
         # Budget against the ACTUAL device footprint: every shard is padded
         # to the max shard row count for one static compiled shape, so an
         # uneven store (merged multi-writer shards) costs
@@ -740,7 +761,8 @@ class SearchService:
                     view.store, self.embedder.mesh,
                     rebuild_drift=self._rebuild_drift,
                     nlist=serve_cfg.nlist, iters=serve_cfg.kmeans_iters,
-                    init=getattr(serve_cfg, "kmeans_init", "kmeans++"))
+                    init=getattr(serve_cfg, "kmeans_init", "kmeans++"),
+                    defer_rebuild=self._defer_rebuilds)
                 action = view.index_info.get("action")
                 if action == "incremental":
                     self._m_incremental.inc()
@@ -749,6 +771,11 @@ class SearchService:
                     self.registry.event("drift_rebuild", {
                         "drift": view.index_info.get("drift"),
                         "nlist": view.index_info.get("nlist")})
+                # a drift overrun deferred off this caller: the gauge is
+                # the hand-off to the background rebuild worker
+                # (docs/MAINTENANCE.md) — it clears when the worker swaps
+                self.registry.gauge("serve.index_rebuild_pending").set(
+                    1.0 if view.index_info.get("rebuild_pending") else 0.0)
             else:
                 view.index = IVFIndex.open(view.store)
             view.index_error = None
@@ -1088,7 +1115,33 @@ class SearchService:
     def batching(self) -> bool:
         return self._batcher is not None
 
+    # -- background maintenance (docs/MAINTENANCE.md) ----------------------
+    def start_maintenance(self, threads: bool = True):
+        """Attach the background MaintenanceService to this service:
+        compaction, off-path index rebuilds, and the janitor run against
+        this store, hot-swapping completed work in via refresh(). Under
+        maintenance.bg_rebuild (the default), drift-triggered full
+        rebuilds are DEFERRED off the refresh() caller from here on — the
+        worker builds the next index generation beside the live one.
+        `threads=False` attaches without spawning workers (callers drive
+        `run_once()` themselves: the loadtest mutator, bench). Idempotent;
+        close() stops it."""
+        if self._maintenance is None:
+            from dnn_page_vectors_tpu.maintenance import MaintenanceService
+            m_cfg = getattr(self.cfg, "maintenance", None)
+            if getattr(m_cfg, "bg_rebuild", True):
+                self._defer_rebuilds = True
+            self._maintenance = MaintenanceService(
+                self.cfg, self.store.directory, self.embedder.mesh,
+                svc=self)
+            if threads:
+                self._maintenance.start()
+        return self._maintenance
+
     def close(self) -> None:
+        if self._maintenance is not None:
+            self._maintenance.close()
+            self._maintenance = None
         if self._batcher is not None:
             self._batcher.close()
             # telemetry survives the thread: metrics() after close still
@@ -1128,6 +1181,11 @@ class SearchService:
             # tombstone-aware restage policy (docs/UPDATES.md)
             "restage_skipped": self.restage_skipped,
             "restage_forced": self.restage_forced,
+            # dead-byte accounting (docs/MAINTENANCE.md): what the
+            # background compactor would reclaim from THIS view's chain
+            "tombstone_density": view.maint_stats["tombstone_density"],
+            "dead_rows": view.maint_stats["dead_rows"],
+            "reclaimable_bytes": view.maint_stats["reclaimable_bytes"],
             # recompilation + adaptive-window state (docs/SERVING.md):
             # how many distinct compiled shapes this service has
             # dispatched, and the micro-batch window currently in force

@@ -396,7 +396,10 @@ class VectorStore:
         return os.path.join(self.directory, f"gen-{int(gen):04d}")
 
     def _load_generations(self) -> None:
-        """Load the longest intact generation chain gen-0001..gen-NNNN.
+        """Load the longest intact generation chain gen-NNNN starting one
+        past the compaction epoch (docs/MAINTENANCE.md: a compacted base
+        FOLDS generations 1..compacted_through, so the live chain resumes
+        after them — generation numbers stay monotonic forever).
         The chain stops at the first missing/torn/stale manifest: a torn
         one is quarantined (renamed aside, counted), and everything AFTER
         the break is unreachable by construction — later generations were
@@ -406,7 +409,7 @@ class VectorStore:
         self._tomb_gen = {}
         self._dead_cache = {}
         step = self.manifest.get("model_step")
-        g = 1
+        g = int(self.manifest.get("compacted_through", 0)) + 1
         while True:
             mpath = os.path.join(self._gen_path(g), "manifest.json")
             if not os.path.exists(mpath):
@@ -448,8 +451,11 @@ class VectorStore:
 
     @property
     def generation(self) -> int:
-        """Current store generation (0 = base embed only)."""
-        return len(self._generations)
+        """Current store generation (0 = base embed only). Monotonic across
+        compactions: folded generations still count, so the next append
+        always chains past every generation number ever committed."""
+        return (int(self.manifest.get("compacted_through", 0))
+                + len(self._generations))
 
     def generations(self) -> List[Dict]:
         """The intact generation manifests, in chain order."""
@@ -463,6 +469,49 @@ class VectorStore:
         """Rows appended by generations > 0 (tombstoned rows included)."""
         return sum(s["count"] for g in self._generations
                    for s in g.get("shards", []))
+
+    @property
+    def compacted_through(self) -> int:
+        """Highest generation folded into a compacted base (0 = never
+        compacted; docs/MAINTENANCE.md)."""
+        return int(self.manifest.get("compacted_through", 0))
+
+    def maintenance_stats(self) -> Dict:
+        """The compaction trigger's inputs (docs/MAINTENANCE.md): tombstone
+        density across the live generation chain, dead rows still occupying
+        store bytes, and the bytes a compaction would reclaim. Every
+        tombstoned id masks exactly one stored row (append_corpus only
+        accepts already-assigned ids, and an update's old row dies when the
+        new one lands), so dead-row accounting is O(tombstone map) — no id
+        files are re-read here."""
+        dead = len(self._tomb_gen)
+        total = self.num_vectors
+        # one dead row costs its stored-width bytes plus the 8-byte id slot
+        # (row_bytes already includes the int8 scale when applicable)
+        return {
+            "tombstone_density": round(dead / max(total, 1), 4),
+            "dead_rows": dead,
+            "reclaimable_bytes": dead * (self.row_bytes + 8),
+            "generations": len(self._generations),
+            "compacted_through": self.compacted_through,
+        }
+
+    # -- ANN index directory pointer (docs/MAINTENANCE.md) -----------------
+    @property
+    def index_dirname(self) -> str:
+        """Directory (relative to the store root) holding the LIVE ANN
+        index. "ivf" by default; a background rebuild builds the next index
+        generation into a sibling dir (ivf-NNNN) and flips this pointer
+        with one atomic manifest dump, so readers move between index
+        generations without ever observing a half-written one."""
+        return self.manifest.get("index_dir", "ivf")
+
+    def set_index_dir(self, name: str) -> None:
+        """Atomically repoint the live index directory (the background
+        rebuild's hot-swap: build beside, flip last)."""
+        self.manifest["index_dir"] = str(name)
+        self._atomic_dump(self.manifest, self._manifest_path,
+                          op="index_swap")
 
     def _dead_for_gen(self, gen: int) -> np.ndarray:
         """Sorted page ids tombstoned by a generation LATER than `gen` —
@@ -504,7 +553,7 @@ class VectorStore:
         shard plus a later append must never double-assign ids
         (docs/UPDATES.md): the quarantined range is re-embedded by resume,
         not re-issued to new documents."""
-        hi = 0
+        hi = int(self.manifest.get("append_cursor", 0))
         ss = self.manifest["shard_size"]
         for s in self.shards():
             if s.get("gen", 0):
@@ -551,7 +600,7 @@ class VectorStore:
         before it. `tombstones` are the page ids this generation kills in
         EARLIER generations (deleted pages, or pages about to be
         re-appended with fresh vectors)."""
-        return GenerationWriter(self, len(self._generations) + 1,
+        return GenerationWriter(self, self.generation + 1,
                                 tombstones=tombstones)
 
     def reset(self) -> None:
@@ -567,14 +616,17 @@ class VectorStore:
                     pass
         for path in self._writer_files():
             os.remove(path)
-        for path in glob.glob(os.path.join(self.directory, "gen-*")):
-            if os.path.isdir(path):
-                shutil.rmtree(path, ignore_errors=True)
+        for pat in ("gen-*", "compact-*"):
+            for path in glob.glob(os.path.join(self.directory, pat)):
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
         self._generations = []
         self._tomb_gen = {}
         self._dead_cache = {}
         self.manifest["shards"] = []
         self.manifest.pop("missing_id_ranges", None)
+        self.manifest.pop("compacted_through", None)
+        self.manifest.pop("append_cursor", None)
         self._writer_shards = []
         self._flush_manifest()
 

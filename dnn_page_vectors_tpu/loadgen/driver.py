@@ -191,6 +191,8 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         rec["schedule_digest"] = schedule_digest
     if mutator is not None:
         rec["mutator_calls"] = mutator.calls - mut0
+        if len(mutator.ops) > 1:
+            rec["mutator_calls_by_op"] = dict(mutator.calls_by_op)
         if mutator.errors:
             rec["mutator_errors"] = mutator.errors
     return rec

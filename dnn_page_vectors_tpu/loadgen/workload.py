@@ -208,14 +208,29 @@ class ClosedLoopWorkload(Workload):
 class Mutator:
     """A concurrent corpus mutation riding along with the load
     (docs/UPDATES.md): every `period_s` of trial time the driver invokes
-    `fn` (typically append_corpus + SearchService.refresh) so the SLO
-    trial measures serving UNDER hot-swap, not beside it. `calls` counts
-    invocations; exceptions are stored, never raised into the trial."""
+    the next op (typically append_corpus + SearchService.refresh) so the
+    SLO trial measures serving UNDER hot-swap, not beside it. `calls`
+    counts invocations; exceptions are stored, never raised into the
+    trial.
 
-    def __init__(self, fn: Callable[[], None], period_s: float = 1.0):
-        self.fn = fn
+    `ops` generalizes the single `fn` to a NAMED round-robin of
+    mutations — the maintenance-under-fire mode (docs/MAINTENANCE.md)
+    alternates tombstone+refresh with a full maintenance pass
+    (compaction + background rebuild), so `cli loadtest --mutate-mode
+    maintain` measures serve p99 with the compactor and rebuilder
+    actually running. `calls_by_op` records how often each fired."""
+
+    def __init__(self, fn: Optional[Callable[[], None]] = None,
+                 period_s: float = 1.0,
+                 ops: Optional[Sequence[Tuple[str, Callable[[], None]]]]
+                 = None):
+        if (fn is None) == (ops is None):
+            raise ValueError("Mutator wants exactly one of fn= or ops=")
+        self.ops: List[Tuple[str, Callable[[], None]]] = (
+            list(ops) if ops is not None else [("mutate", fn)])
         self.period_s = max(1e-3, float(period_s))
         self.calls = 0
+        self.calls_by_op = {name: 0 for name, _ in self.ops}
         self.errors: List[str] = []
 
     def maybe_fire(self, elapsed_s: float, base: int = 0) -> bool:
@@ -225,11 +240,13 @@ class Mutator:
         instead of slowing down as calls accumulate."""
         if elapsed_s < (self.calls - base + 1) * self.period_s:
             return False
+        name, op = self.ops[self.calls % len(self.ops)]
         self.calls += 1
+        self.calls_by_op[name] += 1
         try:
-            self.fn()
+            op()
         except Exception as e:  # noqa: BLE001 — the trial must survive
-            self.errors.append(f"{type(e).__name__}: {e}"[:200])
+            self.errors.append(f"{name}: {type(e).__name__}: {e}"[:200])
         return True
 
 

@@ -1,0 +1,25 @@
+"""Background maintenance for a live page-vector store
+(docs/MAINTENANCE.md): the subsystem that keeps a continuously-updated
+corpus healthy WHILE it serves, with zero reader-visible pauses.
+
+  * `compact` — online generation compaction: fold the gen-NNNN chain +
+    base into a fresh compacted base (dead rows dropped, ids preserved,
+    byte-deterministic), swapped in with one atomic manifest flip;
+  * `lease` — per-writer append leases on the id cursor, so concurrent
+    `cli append` processes queue or fail fast instead of double-assigning
+    page ids;
+  * `service` — the supervised `MaintenanceService` worker pool (one
+    worker per pillar: compactor, off-path index rebuilder, janitor),
+    driven by `cli maintain [--once]` or attached in-process via
+    `SearchService.start_maintenance()`.
+"""
+from dnn_page_vectors_tpu.maintenance.compact import (
+    compact_store, purge_stale)
+from dnn_page_vectors_tpu.maintenance.lease import (
+    AppendLease, LeaseHeld, LeaseLost, expire_stale_lease)
+from dnn_page_vectors_tpu.maintenance.service import MaintenanceService
+
+__all__ = [
+    "AppendLease", "LeaseHeld", "LeaseLost", "MaintenanceService",
+    "compact_store", "expire_stale_lease", "purge_stale",
+]

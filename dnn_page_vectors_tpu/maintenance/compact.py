@@ -1,0 +1,236 @@
+"""Online generation compaction (docs/MAINTENANCE.md).
+
+Tombstones mask dead rows at read time (docs/UPDATES.md) — nothing ever
+reclaims their bytes: a year of appends and deletions leaves the store
+carrying every row it ever wrote, every generation manifest it ever
+committed, and posting lists full of dead candidates. `compact_store`
+folds the whole chain back down:
+
+  * every LIVE row (id not tombstoned) across the base plus the intact
+    generation chain is gathered at STORED width (int8 codes + scales, or
+    fp16 rows — no requantization, so compaction is lossless and
+    byte-deterministic given the same inputs), globally sorted by page id,
+    and re-sharded into fresh shards under `<store>/compact-EEEE/`
+    through the existing CRC-recording writer (`_write_shard_files`:
+    bytes + fsync + size/CRC32 into the entry);
+  * the swap is ONE atomic manifest dump (`compact_swap_dump` /
+    `compact_swap_file` fault ops): the main manifest's shard table is
+    replaced by the compacted entries, `compacted_through` records the
+    folded epoch, and `append_cursor` pins the id high-water mark (a
+    tombstoned top id must never be re-issued). Readers move from
+    old-chain to new-base with that single pointer flip — a crash at any
+    earlier point leaves the old chain fully intact (the compact dir is
+    invisible until the flip), a crash after leaves the new base;
+  * ids are PRESERVED — compaction moves rows, never renames them — and
+    the generation counter stays monotonic: the next append opens
+    generation `compacted_through + 1`.
+
+Old files are not deleted at swap time: a live `_ServeView` may still be
+streaming them. `purge_stale(store, stats)` reclaims them once the caller
+knows no reader holds the old view (the MaintenanceService purges after
+the serving refresh; `cli maintain --once` purges immediately).
+
+The shard table change structurally invalidates any IVF index (its
+recorded table no longer matches — docs/ANN.md), which is the designed
+hand-off: the background rebuilder (maintenance/service.py) builds the
+next index generation over the compacted base and pointer-flips it in.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from dnn_page_vectors_tpu.utils import faults, telemetry
+
+
+def _entry_bytes(entry: Dict) -> int:
+    return sum(int(b) for b in entry.get("bytes", {}).values())
+
+
+def compact_store(store, registry=None) -> Dict:
+    """Fold the generation chain + base into a fresh compacted base and
+    atomically swap it in. Returns the compaction stats dict (action,
+    epoch, rows, dead rows dropped, byte accounting, and the stale
+    dirs/files `purge_stale` reclaims). A store with no generations —
+    nothing to fold — returns {"action": "noop"}."""
+    t0 = time.perf_counter()
+    if store._writer_files():
+        raise ValueError(
+            f"store at {store.directory} has live writer manifests (an "
+            "embed fleet is mid-flight); compact after merge_writers()")
+    prev_epoch = store.compacted_through
+    epoch = store.generation
+    if epoch <= prev_epoch:
+        return {"action": "noop", "reason": "no generations to fold",
+                "generation": epoch}
+    old_entries = store.shards()
+    old_bytes = sum(_entry_bytes(e) for e in old_entries)
+    cursor_before = store.next_page_id()
+
+    # pass 1 — source coordinates: (page id, source entry, source row) for
+    # every stored row, tombstone-masked through load_ids (the one choke
+    # point every reader uses, docs/UPDATES.md)
+    ids_parts, src_parts, row_parts = [], [], []
+    for pos, entry in enumerate(old_entries):
+        ids = np.asarray(store.load_ids(entry), np.int64)
+        ids_parts.append(ids)
+        src_parts.append(np.full(ids.shape, pos, np.int32))
+        row_parts.append(np.arange(ids.shape[0], dtype=np.int64))
+    all_ids = (np.concatenate(ids_parts) if ids_parts
+               else np.zeros((0,), np.int64))
+    src = (np.concatenate(src_parts) if src_parts
+           else np.zeros((0,), np.int32))
+    rows = (np.concatenate(row_parts) if row_parts
+            else np.zeros((0,), np.int64))
+    live = all_ids >= 0
+    dead_rows = int((~live).sum())
+    ids_l, src_l, row_l = all_ids[live], src[live], rows[live]
+    order = np.argsort(ids_l, kind="stable")     # global id order: the
+    ids_l, src_l, row_l = ids_l[order], src_l[order], row_l[order]
+    if ids_l.size and (np.diff(ids_l) == 0).any():
+        raise RuntimeError(
+            "duplicate live page id found while compacting — the store's "
+            "update invariant (old rows tombstoned) is broken; refusing "
+            "to fold")
+
+    # pass 2 — gather + rewrite, one output shard at a time (host memory
+    # stays O(shard) regardless of store size; sources are mmap'd)
+    subdir = f"compact-{epoch:04d}"
+    d = os.path.join(store.directory, subdir)
+    if os.path.isdir(d):
+        # a torn previous attempt never flipped the manifest, so its
+        # directory is invisible garbage — clear it, same as a reused
+        # quarantined generation number (docs/UPDATES.md)
+        shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d, exist_ok=True)
+    is_int8 = store.manifest["dtype"] == "int8"
+    raw_cache: Dict[int, tuple] = {}
+
+    def _raw(pos: int):
+        got = raw_cache.get(pos)
+        if got is None:
+            _, vecs, scl = store._load_entry(old_entries[pos], raw=True)
+            got = raw_cache[pos] = (vecs, scl)
+        return got
+
+    plan = faults.active()
+    new_entries = []
+    next_idx = store._next_shard_index()
+    ss = store.manifest["shard_size"]
+    for s0 in range(0, ids_l.size, ss):
+        ids_c = ids_l[s0: s0 + ss]
+        src_c = src_l[s0: s0 + ss]
+        row_c = row_l[s0: s0 + ss]
+        n = int(ids_c.size)
+        data = np.empty((n, store.dim), np.int8 if is_int8 else np.float16)
+        scl_c = np.empty((n,), np.float16) if is_int8 else None
+        for pos in np.unique(src_c):
+            m = src_c == pos
+            vecs, scl = _raw(int(pos))
+            data[m] = np.asarray(vecs[row_c[m]])
+            if scl_c is not None:
+                scl_c[m] = np.asarray(scl[row_c[m]])
+        plan.check("compact_write")
+        if is_int8:
+            entry = store._write_shard_files(subdir, next_idx, ids_c,
+                                             None, data, scl_c)
+        else:
+            entry = store._write_shard_files(subdir, next_idx, ids_c,
+                                             data, None, None)
+        entry["gen"] = epoch         # masked only by LATER tombstones
+        entry["id_lo"] = int(ids_c.min())
+        entry["id_hi"] = int(ids_c.max()) + 1
+        new_entries.append(entry)
+        next_idx += 1
+
+    # THE swap: one atomic manifest dump moves every reader from the old
+    # chain to the new base; a crash before this line costs nothing but
+    # an invisible compact dir
+    man = dict(store.manifest)
+    man["shards"] = new_entries
+    man["compacted_through"] = epoch
+    man["append_cursor"] = max(int(man.get("append_cursor", 0)),
+                               cursor_before)
+    store._atomic_dump(man, store._manifest_path, op="compact_swap")
+    store.manifest = man
+    store._load_generations()        # chain now resumes past the epoch
+
+    # stale artifacts (reclaimed by purge_stale AFTER readers move over):
+    # folded generation dirs, previous compact dirs, and root-level base
+    # shard files the new manifest no longer references
+    stale_dirs = [store._gen_path(g) for g in range(prev_epoch + 1,
+                                                    epoch + 1)]
+    old_subdirs = {os.path.dirname(e[k]) for e in old_entries
+                   for k in ("vec", "ids", "scl") if k in e}
+    stale_dirs += [os.path.join(store.directory, sd)
+                   for sd in sorted(old_subdirs - {"", subdir})
+                   if sd.startswith("compact-")]
+    stale_files = [os.path.join(store.directory, e[k])
+                   for e in old_entries
+                   for k in ("vec", "ids", "scl")
+                   if k in e and os.path.dirname(e[k]) == ""]
+    new_bytes = sum(_entry_bytes(e) for e in new_entries)
+    seconds = time.perf_counter() - t0
+    stats = {
+        "action": "compacted",
+        "epoch": epoch,
+        "rows": int(ids_l.size),
+        "dead_rows_dropped": dead_rows,
+        "generations_folded": epoch - prev_epoch,
+        "shards": len(new_entries),
+        "store_bytes_before": old_bytes,
+        "store_bytes_after": new_bytes,
+        "bytes_reclaimed": max(0, old_bytes - new_bytes),
+        "seconds": round(seconds, 3),
+        "compact_docs_per_s": round(ids_l.size / max(seconds, 1e-9), 2),
+        "stale_dirs": stale_dirs,
+        "stale_files": stale_files,
+    }
+    reg = registry or telemetry.default_registry()
+    reg.counter("maintenance.compactions").inc()
+    reg.counter("maintenance.compact_bytes_reclaimed").inc(
+        stats["bytes_reclaimed"])
+    reg.gauge("maintenance.compact_docs_per_s").set(
+        stats["compact_docs_per_s"])
+    reg.event("compaction", {
+        "epoch": epoch, "rows": stats["rows"],
+        "dead_rows_dropped": dead_rows,
+        "bytes_reclaimed": stats["bytes_reclaimed"],
+        "seconds": stats["seconds"]})
+    faults.count("store_compactions")
+    return stats
+
+
+def purge_stale(store, stats: Dict) -> Dict:
+    """Reclaim the old chain's bytes after a compaction, once no reader
+    still holds the pre-swap view (the MaintenanceService calls this after
+    the serving refresh; a crashed run's leftovers are swept by the
+    janitor on the next cycle). Never touches a path the CURRENT manifest
+    references, and never leaves the store directory."""
+    referenced = {os.path.normpath(os.path.join(store.directory, e[k]))
+                  for e in store.shards()
+                  for k in ("vec", "ids", "scl") if k in e}
+    removed_dirs, removed_files = 0, 0
+    root = os.path.normpath(store.directory)
+    for path in stats.get("stale_dirs", []):
+        p = os.path.normpath(path)
+        if not p.startswith(root + os.sep) or any(
+                r.startswith(p + os.sep) for r in referenced):
+            continue
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+            removed_dirs += 1
+    for path in stats.get("stale_files", []):
+        p = os.path.normpath(path)
+        if not p.startswith(root + os.sep) or p in referenced:
+            continue
+        try:
+            os.remove(p)
+            removed_files += 1
+        except OSError:
+            pass
+    return {"purged_dirs": removed_dirs, "purged_files": removed_files}

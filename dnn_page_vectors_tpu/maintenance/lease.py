@@ -1,0 +1,226 @@
+"""Per-writer append leases on the store's id cursor (docs/MAINTENANCE.md).
+
+Until this module, "one generation writer at a time" was a convention, not
+a mechanism: two concurrent `cli append` processes would both read the same
+append cursor (`next_page_id`), both open generation G+1, and the second
+`GenerationWriter` would even wipe the first one's half-written directory —
+double-assigned page ids and torn bytes. The lease makes the cursor a
+leased resource:
+
+  * the lease record is ONE json file under the store manifest dir
+    (`append.lease.json`), written through the store's atomic fault-aware
+    dump (`lease_dump`/`lease_file` ops) — manifest-mediated like every
+    other durable byte in the store's blast radius;
+  * the check-then-write critical section is serialized by a short-lived
+    `O_CREAT|O_EXCL` lock file (`append.lease.json.lock`) so two acquirers
+    can never interleave read-and-claim; a crashed holder's lock file goes
+    stale and is broken after `_LOCK_STALE_S`;
+  * leases EXPIRE (`updates.writer_lease_s`): a writer that died mid-append
+    blocks its successors for at most one ttl, after which the next
+    acquirer STEALS the lease (`lease_stolen` event) — the dead writer's
+    uncommitted generation was never visible, so stealing is safe;
+  * a second live writer either QUEUES on the lease (polling until
+    `updates.lease_wait_s` runs out) or fails fast with `LeaseHeld` when
+    the wait budget is 0.
+
+`append_corpus` (updates/append.py) wraps its whole cursor-read → embed →
+commit window in a lease and renews it per shard, so long appends never
+outlive their own ttl. Expiry uses the wall clock on purpose: leases
+coordinate real concurrent processes, and the lease file is coordination
+state, not byte-pinned output (the appended generation bytes stay
+deterministic — the lease never touches them).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from dnn_page_vectors_tpu.utils import faults, telemetry
+
+LEASE_NAME = "append.lease.json"
+# a lock FILE (the O_EXCL critical section, held for one read+write) older
+# than this is a crashed acquirer's leftover and is broken
+_LOCK_STALE_S = 5.0
+_POLL_S = 0.05
+
+_TOKEN_LOCK = threading.Lock()
+_TOKEN_SEQ = 0
+
+
+def _next_token(owner: str) -> str:
+    """Process-unique lease token: owner + pid + a monotone sequence (no
+    entropy needed — uniqueness per process is what the verify-after-write
+    step compares)."""
+    global _TOKEN_SEQ
+    with _TOKEN_LOCK:
+        _TOKEN_SEQ += 1
+        return f"{owner}:{os.getpid()}:{_TOKEN_SEQ}"
+
+
+class LeaseHeld(RuntimeError):
+    """The append lease is held by another live writer and the wait budget
+    ran out — fail fast instead of double-assigning ids."""
+
+
+class LeaseLost(RuntimeError):
+    """This writer's lease expired and was taken over mid-append (renew
+    came too late). The append must abort: its cursor is no longer owned."""
+
+
+class AppendLease:
+    """One writer's claim on a store's append cursor (context manager).
+
+    >>> with AppendLease(store, ttl_s=30.0, wait_s=5.0):
+    ...     cursor = store.next_page_id()   # safe: no other leased writer
+    """
+
+    def __init__(self, store, owner: Optional[str] = None,
+                 ttl_s: float = 30.0, wait_s: float = 5.0,
+                 registry=None):
+        self.store = store
+        self.path = os.path.join(store.directory, LEASE_NAME)
+        self.owner = owner or f"pid-{os.getpid()}"
+        self.token = _next_token(self.owner)
+        self.ttl_s = max(0.1, float(ttl_s))
+        self.wait_s = max(0.0, float(wait_s))
+        self.registry = registry or telemetry.default_registry()
+        self.held = False
+        self.stole_from: Optional[str] = None
+
+    # -- the O_EXCL critical section ---------------------------------------
+    @contextlib.contextmanager
+    def _flock(self):
+        """Serialize check-then-write against every other acquirer (same
+        host or another process on the shared filesystem). Held for one
+        lease-file read + write only; a stale lock file (crashed holder)
+        is broken after _LOCK_STALE_S."""
+        lock = self.path + ".lock"
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                break
+            except FileExistsError:
+                try:
+                    if time.time() - os.path.getmtime(lock) > _LOCK_STALE_S:
+                        os.remove(lock)
+                        faults.count("lease_lock_broken")
+                        continue
+                except OSError:
+                    continue
+                time.sleep(_POLL_S)
+        try:
+            yield
+        finally:
+            os.close(fd)
+            try:
+                os.remove(lock)
+            except OSError:
+                pass
+
+    def _read(self) -> Optional[Dict]:
+        try:
+            import json
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self, now: float) -> None:
+        self.store._atomic_dump({
+            "token": self.token, "owner": self.owner,
+            "acquired": round(now, 3),
+            "expires": round(now + self.ttl_s, 3),
+            "cursor": self.store.next_page_id(),
+        }, self.path, op="lease")
+
+    # -- protocol ----------------------------------------------------------
+    def acquire(self) -> "AppendLease":
+        """Claim the cursor: free/expired leases are taken immediately
+        (expired-but-present ones count as STOLEN), a live foreign lease is
+        polled until `wait_s` runs out, then LeaseHeld."""
+        deadline = time.monotonic() + self.wait_s
+        while True:
+            with self._flock():
+                cur = self._read()
+                now = time.time()
+                expired = cur is not None and float(
+                    cur.get("expires", 0)) <= now
+                if cur is None or expired or cur.get("token") == self.token:
+                    self.stole_from = (cur.get("owner")
+                                       if cur is not None and expired
+                                       else None)
+                    self._write(now)
+                    self.held = True
+                    faults.count("lease_acquired")
+                    self.registry.event("lease_acquired", {
+                        "owner": self.owner,
+                        "stolen_from": self.stole_from})
+                    if self.stole_from is not None:
+                        faults.count("lease_stolen")
+                        self.registry.event("lease_stolen", {
+                            "owner": self.owner,
+                            "from": self.stole_from})
+                    return self
+                holder = cur.get("owner", "?")
+            if time.monotonic() >= deadline:
+                raise LeaseHeld(
+                    f"append lease on {self.store.directory} is held by "
+                    f"{holder} (expires in "
+                    f"{float(cur.get('expires', 0)) - now:.1f}s); "
+                    "queue longer (updates.lease_wait_s) or retry")
+            time.sleep(_POLL_S)
+
+    def renew(self) -> None:
+        """Extend the ttl mid-append (called per shard by append_corpus) —
+        a long append never outlives its own lease. Raises LeaseLost when
+        another writer took over (this append must abort)."""
+        if not self.held:
+            raise RuntimeError("renew() before acquire()")
+        with self._flock():
+            cur = self._read()
+            if cur is None or cur.get("token") != self.token:
+                self.held = False
+                raise LeaseLost(
+                    f"append lease on {self.store.directory} was taken by "
+                    f"{(cur or {}).get('owner', '?')} — this writer's ttl "
+                    "expired mid-append; raise updates.writer_lease_s")
+            self._write(time.time())
+
+    def release(self) -> None:
+        """Drop the lease (idempotent; never removes a foreign lease)."""
+        if not self.held:
+            return
+        with self._flock():
+            cur = self._read()
+            if cur is not None and cur.get("token") == self.token:
+                try:
+                    os.remove(self.path)
+                except OSError:
+                    pass
+        self.held = False
+
+    def __enter__(self) -> "AppendLease":
+        return self.acquire() if not self.held else self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def expire_stale_lease(store, registry=None) -> bool:
+    """Janitor sweep (maintenance/service.py): remove an EXPIRED lease file
+    so the next acquirer starts clean instead of paying the steal path.
+    Returns True when one was removed."""
+    lease = AppendLease(store, owner="janitor", registry=registry)
+    with lease._flock():
+        cur = lease._read()
+        if cur is None or float(cur.get("expires", 0)) > time.time():
+            return False
+        try:
+            os.remove(lease.path)
+        except OSError:
+            return False
+    faults.count("lease_expired")
+    return True

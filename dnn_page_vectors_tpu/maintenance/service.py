@@ -1,0 +1,380 @@
+"""The background maintenance service (docs/MAINTENANCE.md).
+
+`MaintenanceService` supervises one worker thread per pillar, each polling
+its trigger every `maintenance.interval_s` seconds and running its job
+under one shared mutation lock (two pillars must never interleave manifest
+flips):
+
+  * **compactor** — when the chain's tombstone density crosses
+    `maintenance.compact_tombstone_density`, fold the generation chain
+    into a fresh compacted base (maintenance/compact.py), rebuild the IVF
+    index over it when one exists, hot-swap the serving view, then purge
+    the old chain's bytes;
+  * **rebuilder** — when a drift rebuild was deferred off the refresh()
+    path (`serve.index_rebuild_pending`, docs/UPDATES.md) or the live
+    index degraded to exact, build the next index generation BESIDE the
+    live one (`IVFIndex.build(dirname=...)` reusing the recorded
+    pq/balance config), flip the store's index-dir pointer atomically,
+    and hot-swap via the existing `_ServeView` refresh — a drift rebuild
+    never again blocks an append or a query;
+  * **janitor** — sweep expired append leases, stale index generations
+    (dirs the pointer moved off), and compaction debris a crashed run
+    left behind. Old artifacts are deleted one full cycle after they go
+    stale, so in-flight readers on the previous view never lose a file
+    mid-query.
+
+Every mutation goes through the manifest writers (`_write_shard_files`,
+`_atomic_dump`, `set_index_dir`); worker exceptions are counted
+(`maintenance_<pillar>_errors`), logged, and never kill the worker. The
+service is driven by `cli maintain [--once]`, or attached in-process to a
+`SearchService` via `start_maintenance()` — which also moves drift
+rebuilds off the refresh path (`maintenance.bg_rebuild`).
+
+API: `start()` (spawn the workers, idempotent), `pause()`/`resume()`
+(freeze/unfreeze trigger checks), `drain()` (block until in-flight jobs
+finish), `run_once()` (one synchronous pass of all three pillars — works
+with or without the threads), `close()` (stop + join).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.maintenance.compact import (
+    compact_store, purge_stale)
+from dnn_page_vectors_tpu.maintenance.lease import expire_stale_lease
+from dnn_page_vectors_tpu.utils import faults, telemetry
+
+_INDEX_DIR_RE = re.compile(r"^ivf(-\d+)?$")
+
+
+def _next_index_dirname(current: str) -> str:
+    """ivf -> ivf-0001 -> ivf-0002 ... (the next index generation's home,
+    built beside the live one and pointer-flipped in)."""
+    m = re.match(r"^ivf-(\d+)$", current)
+    return f"ivf-{(int(m.group(1)) if m else 0) + 1:04d}"
+
+
+class MaintenanceService:
+    """Supervised pillar workers over one store (docs/MAINTENANCE.md).
+
+    `svc` (optional) attaches a live `SearchService`: its registry carries
+    the maintenance instruments, completed swaps hot-swap the serving view
+    through `svc.refresh()`, and background rebuilds count into the
+    service's `full_rebuilds` — the acceptance pin that rebuilds happen
+    ONLY here, never on the refresh caller."""
+
+    PILLARS = ("compaction", "rebuild", "janitor")
+
+    def __init__(self, cfg, store_dir: str, mesh, svc=None, registry=None):
+        self._cfg = cfg
+        self._store_dir = store_dir
+        self._mesh = mesh
+        self._svc = svc
+        self.registry = registry or (
+            svc.registry if svc is not None
+            else telemetry.default_registry())
+        m = getattr(cfg, "maintenance", None)
+        self._density = (getattr(m, "compact_tombstone_density", 0.2)
+                         if m is not None else 0.2)
+        self._interval_s = (getattr(m, "interval_s", 5.0)
+                            if m is not None else 5.0)
+        self._lock = threading.Lock()
+        # one mutation at a time across pillars AND run_once (re-entrant:
+        # run_once drives all three jobs under one hold)
+        self._mlock = threading.RLock()
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._paused = False                  # guarded-by: _lock
+        self._busy = 0                        # guarded-by: _lock
+        self._stats: Dict[str, int] = {}      # guarded-by: _lock
+        self._last: Dict[str, Dict] = {}      # guarded-by: _lock
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "MaintenanceService":
+        """Spawn one worker per pillar (idempotent)."""
+        if self._threads:
+            return self
+        for name, job in (("compaction", self._compact_once),
+                          ("rebuild", self._rebuild_once),
+                          ("janitor", self._janitor_once)):
+            t = threading.Thread(target=self._run_worker, args=(name, job),
+                                 daemon=True, name=f"maint-{name}")
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def _run_worker(self, name: str, job: Callable[[], Optional[Dict]]
+                    ) -> None:
+        while not self._stop.wait(self._interval_s):
+            with self._lock:
+                paused = self._paused
+            if paused:
+                continue
+            self._guarded_job(name, job)
+
+    def _guarded_job(self, name: str, job: Callable[[], Optional[Dict]]
+                     ) -> Optional[Dict]:
+        """One supervised pillar pass: mutation lock held, exceptions
+        counted and reported, never propagated into the worker loop."""
+        with self._lock:
+            self._busy += 1
+        try:
+            with self._mlock:
+                res = job()
+        except Exception as e:  # noqa: BLE001 — the worker must survive
+            res = {"error": f"{type(e).__name__}: {e}"[:300]}
+            faults.count(f"maintenance_{name}_errors")
+            faults.warn(f"maintenance {name} pass failed "
+                        f"({res['error']}); the worker keeps polling")
+        finally:
+            with self._lock:
+                self._busy -= 1
+        if res is not None:
+            with self._lock:
+                self._stats[name] = self._stats.get(name, 0) + 1
+                self._last[name] = res
+        return res
+
+    def pause(self) -> None:
+        """Stop triggering new jobs (in-flight ones finish; see drain)."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Block until no pillar job is in flight. True when drained."""
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while True:
+            with self._lock:
+                if self._busy == 0:
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def close(self) -> None:
+        """Stop the workers and join them (drains in-flight jobs)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join()
+        self._threads = []
+
+    def run_once(self) -> Dict:
+        """One synchronous pass of every pillar (janitor first so a
+        crashed prior run's debris never confuses the triggers) — the
+        `cli maintain --once` / bench / loadgen-mutator entry point.
+        Works with or without the background threads running."""
+        out: Dict[str, Dict] = {}
+        with self._mlock:
+            for name, job in (("janitor", self._janitor_once),
+                              ("compaction", self._compact_once),
+                              ("rebuild", self._rebuild_once)):
+                res = self._guarded_job(name, job)
+                if res is not None:
+                    out[name] = res
+        return out
+
+    def stats(self) -> Dict:
+        """Pass counts + each pillar's last result (telemetry snapshot)."""
+        with self._lock:
+            return {"passes": dict(self._stats),
+                    "last": {k: dict(v) for k, v in self._last.items()}}
+
+    # -- pillar: generation compaction -------------------------------------
+    def _compact_once(self) -> Optional[Dict]:
+        # trigger check on an unverified handle (a CRC sweep per poll
+        # would re-read every shard's bytes every interval_s); the
+        # compaction itself re-opens WITH the verify gate
+        store = VectorStore(self._store_dir, verify=False)
+        ms = store.maintenance_stats()
+        reg = self.registry
+        reg.gauge("maintenance.tombstone_density").set(
+            ms["tombstone_density"])
+        reg.gauge("maintenance.dead_rows").set(ms["dead_rows"])
+        reg.gauge("maintenance.reclaimable_bytes").set(
+            ms["reclaimable_bytes"])
+        if (store.generation <= store.compacted_through
+                or ms["tombstone_density"] < self._density):
+            return None
+        store = VectorStore(self._store_dir)     # verified handle
+        had_index = os.path.exists(os.path.join(
+            store.directory, store.index_dirname, "manifest.json"))
+        stats = compact_store(store, registry=reg)
+        if stats.get("action") != "compacted":
+            return stats
+        if had_index:
+            # rebuild over the compacted base BEFORE the serving refresh:
+            # the view swap then lands store + index together, with no
+            # degraded-to-exact window in between
+            stats["index_rebuild"] = self._swap_index(
+                store, reason=f"generation compaction epoch "
+                              f"{stats['epoch']}", refresh=False)
+        if self._svc is not None:
+            info = self._svc.refresh()
+            stats["refresh_swap_ms"] = info.get("swap_ms")
+        # reclaim only after the serving view moved over — in-flight
+        # buckets on the old view finished during the refresh swap
+        stats["purged"] = purge_stale(store, stats)
+        stats.pop("stale_dirs", None)
+        stats.pop("stale_files", None)
+        return stats
+
+    # -- pillar: off-path index rebuilds -----------------------------------
+    def _rebuild_once(self) -> Optional[Dict]:
+        svc = self._svc
+        reason = None
+        if svc is not None:
+            if svc._serve_index != "ivf":
+                return None
+            pending = svc.registry.gauge(
+                "serve.index_rebuild_pending").value > 0
+            err = svc._view.index_error
+            store0 = VectorStore(self._store_dir, verify=False)
+            has_manifest = os.path.exists(os.path.join(
+                store0.directory, store0.index_dirname, "manifest.json"))
+            if pending:
+                reason = "drift rebuild deferred off the refresh path"
+            elif err is not None and has_manifest:
+                reason = f"live index degraded ({err[:120]})"
+        else:
+            store0 = VectorStore(self._store_dir, verify=False)
+            mpath = os.path.join(store0.directory, store0.index_dirname,
+                                 "manifest.json")
+            if os.path.exists(mpath):
+                reason = self._standalone_rebuild_reason(store0, mpath)
+        if reason is None:
+            return None
+        store = VectorStore(self._store_dir)
+        if store.num_vectors == 0:
+            return None
+        return self._swap_index(store, reason=reason)
+
+    def _standalone_rebuild_reason(self, store,
+                                   mpath: str) -> Optional[str]:
+        """Without a live service, decide from the on-disk index: drift
+        past updates.rebuild_drift, or structural staleness open() would
+        reject (compaction, quarantine, re-stamp)."""
+        from dnn_page_vectors_tpu.index.ivf import (
+            IndexUnavailable, IVFIndex)
+        try:
+            with open(mpath) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return "torn index manifest"
+        drift = (int(man.get("appended_since_build", 0))
+                 / max(store.num_vectors, 1))
+        limit = getattr(getattr(self._cfg, "updates", None),
+                        "rebuild_drift", 0.25)
+        if drift > limit:
+            return f"drift {drift:.3f} > rebuild_drift {limit}"
+        try:
+            IVFIndex.open(store, verify=True)
+        except IndexUnavailable as e:
+            return f"index unavailable ({str(e)[:120]})"
+        except Exception as e:  # noqa: BLE001 — unreadable = rebuild
+            return f"index unreadable ({type(e).__name__})"
+        return None
+
+    def _swap_index(self, store, reason: str,
+                    refresh: bool = True) -> Dict:
+        """Build the next index generation beside the live one, flip the
+        store's index-dir pointer atomically, and (with a service
+        attached) hot-swap the serving view. The old index directory is
+        left on disk for the janitor — a reader on the previous view may
+        still be mmap-ing its code files."""
+        from dnn_page_vectors_tpu.index.ivf import IVFIndex
+        faults.active().check("bg_rebuild")
+        old_name = store.index_dirname
+        old_man: Dict = {}
+        mpath = os.path.join(store.directory, old_name, "manifest.json")
+        if os.path.exists(mpath):
+            try:
+                with open(mpath) as f:
+                    old_man = json.load(f)
+            except (OSError, ValueError):
+                old_man = {}
+        next_name = _next_index_dirname(old_name)
+        serve = self._cfg.serve
+        pq_cfg = old_man.get("pq") or {}
+        t0 = time.perf_counter()
+        idx = IVFIndex.build(
+            store, self._mesh, nlist=getattr(serve, "nlist", 0),
+            iters=getattr(serve, "kmeans_iters", 8),
+            seed=self._cfg.data.seed,
+            init=getattr(serve, "kmeans_init", "kmeans++"),
+            balance=old_man.get("balance",
+                                getattr(serve, "kmeans_balance", 0.0)),
+            pq_m=pq_cfg.get("m", 0), pq_iters=pq_cfg.get("iters", 8),
+            opq_iters=pq_cfg.get("opq_iters", 3), dirname=next_name)
+        build_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        store.set_index_dir(next_name)       # THE pointer flip
+        rb = {"reason": reason[:200], "dirname": next_name,
+              "nlist": idx.nlist, "build_seconds": round(build_s, 3)}
+        if refresh and self._svc is not None:
+            self._svc.refresh()
+        if self._svc is not None:
+            self._svc._m_rebuilds.inc()
+            self._svc.registry.gauge("serve.index_rebuild_pending").set(0.0)
+        rb["swap_ms"] = round((time.perf_counter() - t1) * 1000.0, 3)
+        self.registry.counter("maintenance.bg_rebuilds").inc()
+        self.registry.gauge("maintenance.bg_rebuild_swap_ms").set(
+            rb["swap_ms"])
+        self.registry.event("index_rebuild_bg", rb)
+        faults.count("index_bg_rebuilds")
+        return rb
+
+    # -- pillar: janitor ---------------------------------------------------
+    def _janitor_once(self) -> Optional[Dict]:
+        store = VectorStore(self._store_dir, verify=False)
+        out = {"lease_expired": False, "index_dirs_removed": 0,
+               "purged_dirs": 0, "purged_files": 0}
+        if expire_stale_lease(store, registry=self.registry):
+            out["lease_expired"] = True
+            self.registry.counter("maintenance.leases_expired").inc()
+        cur = store.index_dirname
+        live_idx = os.path.join(store.directory, cur)
+        for path in sorted(glob.glob(os.path.join(store.directory,
+                                                  "ivf*"))):
+            name = os.path.basename(path)
+            if (path == live_idx or not os.path.isdir(path)
+                    or not _INDEX_DIR_RE.match(name)):
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            out["index_dirs_removed"] += 1
+        epoch = store.compacted_through
+        if epoch:
+            referenced = {os.path.dirname(e[k]) for e in store.shards()
+                          for k in ("vec", "ids", "scl") if k in e}
+            ref_files = {e[k] for e in store.shards()
+                         for k in ("vec", "ids", "scl")
+                         if k in e and os.path.dirname(e[k]) == ""}
+            stale = {"stale_dirs": [], "stale_files": []}
+            for path in glob.glob(os.path.join(store.directory, "gen-*")):
+                m = re.match(r"^gen-(\d+)$", os.path.basename(path))
+                if m and int(m.group(1)) <= epoch and os.path.isdir(path):
+                    stale["stale_dirs"].append(path)
+            for path in glob.glob(os.path.join(store.directory,
+                                               "compact-*")):
+                if (os.path.isdir(path)
+                        and os.path.basename(path) not in referenced):
+                    stale["stale_dirs"].append(path)
+            for path in glob.glob(os.path.join(store.directory,
+                                               "shard_*.npy")):
+                if os.path.basename(path) not in ref_files:
+                    stale["stale_files"].append(path)
+            purged = purge_stale(store, stale)
+            out["purged_dirs"] = purged["purged_dirs"]
+            out["purged_files"] = purged["purged_files"]
+        return out if any(out.values()) else None

@@ -54,7 +54,7 @@ class ManifestIORule(Rule):
     doc = ("bare open(...,'w')/np.save in store-adjacent write paths must "
            "route through write_shard/_atomic_dump/crc_file")
     scope = (f"{PKG_NAME}/index/", f"{PKG_NAME}/updates/",
-             f"{PKG_NAME}/train/checkpoint.py")
+             f"{PKG_NAME}/train/checkpoint.py", f"{PKG_NAME}/maintenance/")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         yield from self._scan(ctx, ctx.tree, sanctioned=False, stack=[])

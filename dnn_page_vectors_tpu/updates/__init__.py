@@ -15,6 +15,12 @@ store/index/serve stack mutable end to end:
     rotation/codebooks, the same O(new shards) append;
   * `SearchService.refresh` (infer/serve.py) atomically swaps the new
     store view + index generation under live traffic.
+
+Appends run under a per-writer lease on the id cursor
+(dnn_page_vectors_tpu/maintenance/lease.py, docs/MAINTENANCE.md), so
+concurrent writers queue or fail fast instead of double-assigning ids;
+the background maintenance service folds the resulting generation chain
+back down once tombstone density crosses the compaction threshold.
 """
 from dnn_page_vectors_tpu.updates.append import append_corpus
 

@@ -24,6 +24,7 @@ import numpy as np
 
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
 from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.maintenance.lease import AppendLease
 from dnn_page_vectors_tpu.utils import faults, telemetry
 
 
@@ -32,12 +33,20 @@ def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
                   tombstone: Iterable[int] = (),
                   update_ids: Iterable[int] = (),
                   batch_size: Optional[int] = None,
-                  log=None) -> Dict:
+                  log=None, lease: bool = True) -> Dict:
     """Embed corpus pages [start, stop) — default: everything past the
     store's append cursor — plus `update_ids` (existing pages re-embedded
     with fresh text) into a new generation; `tombstone` page ids are
     deleted outright. Updated ids are tombstoned automatically, so their
     old rows mask out while the new rows serve.
+
+    Multi-writer safety (docs/MAINTENANCE.md): the whole cursor-read →
+    embed → commit window runs under a per-writer append lease
+    (`updates.writer_lease_s` ttl, renewed per shard so long appends
+    never outlive it; `updates.lease_wait_s` queue budget) — a second
+    concurrent writer queues on the lease or fails fast with LeaseHeld,
+    and can never read the same cursor. `lease=False` opts out for
+    callers that hold their own serialization.
 
     Returns the append stats dict (generation, appended, updated,
     tombstoned, id range, shards, seconds). A no-op delta (nothing new,
@@ -48,6 +57,28 @@ def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
         raise ValueError(
             "store is unstamped (no model_step); run the base 'embed' "
             "before appending — appends must share the base params")
+    upd_cfg = getattr(embedder.cfg, "updates", None)
+    held = None
+    if lease:
+        held = AppendLease(
+            store,
+            ttl_s=getattr(upd_cfg, "writer_lease_s", 30.0),
+            wait_s=getattr(upd_cfg, "lease_wait_s", 5.0)).acquire()
+        # another writer may have committed while this one queued on the
+        # lease: re-read the manifest + chain so cursor and generation
+        # number reflect the store as the lease found it
+        store.reload()
+        store.reload_generations()
+    try:
+        return _append_leased(embedder, corpus, store, start, stop,
+                              tombstone, update_ids, batch_size, log, held)
+    finally:
+        if held is not None:
+            held.release()
+
+
+def _append_leased(embedder, corpus, store, start, stop, tombstone,
+                   update_ids, batch_size, log, held) -> Dict:
     cursor = store.next_page_id()
     start = cursor if start is None else int(start)
     if start < cursor:
@@ -84,6 +115,11 @@ def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
                 [corpus.page_text(int(i)) for i in ids], tower="page",
                 batch_size=bs)
             writer.write_shard(ids, vecs)
+            if held is not None:
+                # a long append must not outlive its own lease: renew per
+                # shard; LeaseLost here aborts before a double-assigned
+                # commit can land (docs/MAINTENANCE.md)
+                held.renew()
         man = writer.commit()
     except BaseException:
         writer.abort()     # readers never see a half-written generation

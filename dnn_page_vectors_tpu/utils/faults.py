@@ -42,6 +42,20 @@ Injection points (op names):
                  and serving falls back to exact, visibly
   index_file     an IVF index file after fsync (corrupt)
   index_read     IVF posting load on open (check)
+  compact_write  per-shard compacted-base write (check; docs/MAINTENANCE.md
+                 — the compacted shard FILES additionally go through
+                 shard_write/shard_file like every shard)
+  compact_swap_dump  the compaction's atomic main-manifest flip (check;
+                 inside retry) — tearing it here leaves the OLD chain
+                 serving and the compact dir invisible
+  compact_swap_file  the flip's tmp file before rename (corrupt)
+  index_swap_dump    the background rebuild's index-dir pointer flip
+                 (check; inside retry)
+  index_swap_file    the pointer flip's tmp file before rename (corrupt)
+  bg_rebuild     start of a background index rebuild (check) — the
+                 build's own writes still carry index_write/index_file
+  lease_dump     append-lease file write (check; inside retry)
+  lease_file     the lease tmp file before rename (corrupt)
 
 Plan syntax (config `faults.plan` / CLI `--faults`):
   "op:kind:at[:count]" joined by commas; `at` is the 0-based index of the
