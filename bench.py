@@ -204,6 +204,26 @@ class _SyntheticTok:
             size=(len(texts), self.max_tokens), dtype=np.int32)
 
 
+def _roofline_keys(prefix: str, cfg, batch: int, pps: float, peak,
+                   dev) -> dict:
+    """<prefix>roofline_util + the binding wall next to every MFU column
+    (docs/MFU.md "roofline methodology"): achieved pairs/sec over the
+    analytic min(compute, memory) ceiling — the number that stays
+    meaningful for gather-dominated encoders where bf16-peak MFU reads
+    as 3% by construction."""
+    from dnn_page_vectors_tpu.utils.flops import (
+        device_peak_hbm_bps, roofline, train_bytes_per_pair,
+        train_flops_per_pair)
+    ceil, bound = roofline(train_flops_per_pair(cfg, batch),
+                           train_bytes_per_pair(cfg, batch),
+                           peak, device_peak_hbm_bps(dev))
+    if ceil is None:
+        return {}
+    return {f"{prefix}roofline_ceiling_pps": round(ceil, 1),
+            f"{prefix}roofline_util": round(pps / ceil, 4),
+            f"{prefix}roofline_bound": bound}
+
+
 def run_worker() -> None:
     from dnn_page_vectors_tpu.utils.platform import hard_sync, honor_jax_platforms_env
     honor_jax_platforms_env()
@@ -298,7 +318,56 @@ def run_worker() -> None:
     train_pps_chip = batch * timed_steps / dt / n_dev
     train_flops = train_flops_per_pair(cfg, batch)
     train_mfu = (train_pps_chip * train_flops / peak) if peak else None
-    _stamp(f"train timed: {train_pps_chip:.1f} pages/s/chip; compiling embed")
+    _stamp(f"train timed: {train_pps_chip:.1f} pages/s/chip")
+
+    # ---- fused-loss A/B (round 11, train.loss_chunk) --------------------
+    # The chunked contrastive loss streams query chunks against the
+    # GSPMD-gathered page pool instead of materializing [B, B] logits
+    # (models/losses.py) — numerically pinned equal, so the A/B here is a
+    # PERF datapoint: the fused step must hold the dense step's rate
+    # while freeing the logits HBM that caps the in-batch negative pool.
+    # Skippable via BENCH_FUSED=0.
+    fused_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "256"))
+    if os.environ.get("BENCH_FUSED", "1") != "0" and fused_chunk > 0 \
+            and batch % fused_chunk == 0:
+        try:
+            import dataclasses as _dcf
+
+            fcfg = cfg.replace(train=_dcf.replace(cfg.train,
+                                                  loss_chunk=fused_chunk))
+            ftrainer = Trainer(fcfg, corpus=trainer.corpus,
+                               workdir="/tmp/dnn_page_vectors_tpu_bench")
+            fstate = ftrainer.init_state()
+            fstep = ftrainer.compiled_step(fstate)
+            fit = iter(ftrainer.batches())
+            fbatches = [next(fit) for _ in range(2)]
+            frng = ftrainer.base_rng()
+            for i in range(2):
+                fstate, fm = fstep(fstate, fbatches[i % 2], frng)
+            hard_sync(fm)
+            _stamp(f"fused-loss step compiled (chunk={fused_chunk}); timing")
+            fsteps = max(8, timed_steps // 2)
+
+            def _fused_loop():
+                nonlocal fstate
+                for i in range(fsteps):
+                    fstate, fm = fstep(fstate, fbatches[i % 2], frng)
+                return fm
+
+            fdt = _best_time(_fused_loop, opt_reps)
+            f_pps = batch * fsteps / fdt / n_dev
+            rec_fused = {
+                "train_fused_loss_pages_per_sec_per_chip": round(f_pps, 2),
+                "train_fused_loss_vs_dense": round(f_pps / train_pps_chip,
+                                                   4),
+                "train_loss_chunk": fused_chunk,
+            }
+            del fstate, fstep, fbatches
+        except Exception as e:   # optional A/B must never cost the round
+            rec_fused = {"fused_error": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        rec_fused = {}
+    _stamp("compiling embed")
 
     # ---- bulk-embed sweep (forward-only encode_page, device-resident) ----
     from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
@@ -356,6 +425,9 @@ def run_worker() -> None:
         "n_devices": n_dev,
         "device_kind": getattr(devs[0], "device_kind", "unknown"),
         "peak_bf16_flops": peak,
+        **rec_fused,
+        **_roofline_keys("train_", cfg, batch, train_pps_chip, peak,
+                         devs[0]),
         # recovery-path activity during the bench (docs/ROBUSTNESS.md):
         # normally {} / False — a non-empty counter set in a bench record
         # means the run survived faults (retries, quarantines, rollbacks)
@@ -941,12 +1013,21 @@ def run_worker() -> None:
                    f"{ceiling:,.0f} pages/s; timing full 1M sweep")
             tdt = _best_time(_sweep, opt_reps)
             etext_pps = n_text / tdt / n_dev
+            # MEASURED drain rate of the job's own packed d2h transfers
+            # (bytes and seconds from the PipelineProfiler, round 11) —
+            # the probe-based number keeps setting the transport CEILING,
+            # but the recorded embed_d2h_mbytes_per_sec is now what the
+            # sweep actually achieved, one packed device_get per dispatch
+            eprof_s = eprof.stages().get("d2h", 0.0)
+            d2h_measured = (eprof.stage_bytes().get("d2h", 0) / eprof_s
+                            / 1e6 if eprof_s > 0 else 0.0)
             rec.update({
                 "embed_from_text_pages_per_sec_per_chip": round(etext_pps, 2),
                 "embed_from_text_pages": n_text,
                 "embed_from_text_vs_device": round(
                     etext_pps / embed_pps_chip, 4),
-                "embed_d2h_mbytes_per_sec": round(d2h_bps / 1e6, 1),
+                "embed_d2h_mbytes_per_sec": round(d2h_measured, 1),
+                "embed_d2h_probe_mbytes_per_sec": round(d2h_bps / 1e6, 1),
                 "embed_from_text_transport_ceiling_pps": round(ceiling, 1),
                 "embed_from_text_vs_transport_ceiling": round(
                     min(etext_pps / ceiling, 9.99), 4),
@@ -1058,6 +1139,8 @@ def run_worker() -> None:
                                       if peak else None),
                     "mt5_vocab_size": mvocab,
                     "mt5_model_dim": mcfg.model.model_dim,
+                    **_roofline_keys("mt5_", mcfg, m_batch, mpps, peak,
+                                     devs[0]),
                 })
             finally:
                 # free the multi-GB mt5 state even on failure, or the
@@ -1088,8 +1171,15 @@ def run_worker() -> None:
             try:
                 _stamp(f"building {key} phase (synthetic-id batches, "
                        f"attempt {_w_attempt + 1})")
+                # 2048/chip (round 11, was 512): the word-family step is
+                # ~1 ms of analytic device work at 512 — far below the
+                # per-dispatch floor of the tunneled backend, so the old
+                # batch measured dispatch latency, not the encoder. The
+                # per-model batch sizing puts enough work per step that
+                # the MFU/roofline columns describe the model
+                # (docs/MFU.md "word-family accounting fix").
                 w_batch = int(os.environ.get("BENCH_WORD_BATCH",
-                                             "512")) * n_dev
+                                             "2048")) * n_dev
                 wcfg = get_config(cname, {
                     "data.num_pages": max(4_096, w_batch),
                     "train.batch_size": w_batch,
@@ -1130,6 +1220,9 @@ def run_worker() -> None:
                         f"{key}_train_pages_per_sec_per_chip": round(wpps, 2),
                         f"{key}_train_mfu": (round(wpps * wflops / peak, 4)
                                              if peak else None),
+                        f"{key}_batch_per_chip": w_batch // n_dev,
+                        **_roofline_keys(f"{key}_", wcfg, w_batch, wpps,
+                                         peak, devs[0]),
                     })
                 finally:
                     del wstate, wstep, wbatches
@@ -1187,8 +1280,23 @@ def run_worker() -> None:
             "long_train_mfu": (round(lpps * lflops / peak, 4)
                                if peak else None),
             "long_page_len": lcfg.data.page_len,
+            **_roofline_keys("long_", lcfg, lcfg.train.batch_size, lpps,
+                             peak, devs[0]),
         })
         del lstate, lstep, lbatches     # free HBM for the t5 variant
+
+        # sequence-packing A/B at long geometry (round 11, BENCH_PACK=0
+        # skips): see _long_pack for the protocol + accounting
+        if os.environ.get("BENCH_PACK", "1") != "0":
+            for _p_attempt in range(2):
+                try:
+                    _long_pack(rec, n_dev, peak, opt_reps, _best_time,
+                               _stamp, devs[0])
+                except Exception as e:
+                    rec["long_pack_error"] = f"{type(e).__name__}: {e}"[:300]
+                    continue
+                rec.pop("long_pack_error", None)
+                break
 
         # t5 long-context variant (round 4): the Pallas dbias backward
         # keeps the T5-biased flash path O(L) in training too, so long
@@ -1210,6 +1318,105 @@ def run_worker() -> None:
       rec.pop("long_error", None)
       break
     _emit(rec)
+
+
+def _long_pack(rec, n_dev, peak, opt_reps, _best_time, _stamp,
+               dev) -> None:
+    """Sequence-packing A/B at bert_long_sp geometry (train.pack_pages,
+    docs/MFU.md "packing accounting").
+
+    The production long-page scenario: the program compiles ONE static
+    [B, 1024] row shape, but real long-page corpora are mixed-length —
+    short pages ride padded rows and the pad tokens burn full-row
+    compute. Protocol: a corpus of ~230-word pages through the SAME
+    flash bert-long model, (a) unpacked — each page padded to the 1024
+    row, the pre-packing behavior — and (b) packed 4-per-row with the
+    segment mask. Accounting: both runs report USEFUL-flops MFU (flops
+    of the pages' actual tokens, measured from the batch, NOT the padded
+    row), so the pad waste the unpacked run burns is visible instead of
+    flattered; long_pack_mfu_gain is the packing win in those terms and
+    long_pack_speedup the raw pages/sec ratio. The full-length-page
+    long_train_mfu above is untouched (its rows have no pad to pack)."""
+    import dataclasses as _dcp
+
+    import numpy as _npp
+
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.data.toy import ToyCorpus
+    from dnn_page_vectors_tpu.train.loop import Trainer
+    from dnn_page_vectors_tpu.utils.flops import encoder_flops_per_example
+    from dnn_page_vectors_tpu.utils.platform import hard_sync
+
+    pack = int(os.environ.get("BENCH_PACK_PAGES", "4"))
+    batch = int(os.environ.get("BENCH_LONG_BATCH", "64"))
+    psteps = int(os.environ.get("BENCH_PACK_STEPS", "24"))
+    base = get_config("bert_long_sp", {
+        "data.num_pages": 2_048,
+        "data.vocab_size": 8_192,
+        "model.attention": "flash",
+        "train.batch_size": batch,
+        "train.log_every": 1_000_000,
+        "mesh.data": n_dev, "mesh.seq": 1,
+    })
+    # ~215-word pages tokenize to ~243 wordpieces on the toy corpus
+    # (~1.13 tokens/word measured), so 4 pages fit one 1024-token row
+    # with headroom — pack=4 rows carry 4x the pages, no truncation
+    corpus = ToyCorpus(num_pages=2_048, seed=0,
+                       page_len=int(os.environ.get("BENCH_PACK_WORDS",
+                                                   "215")),
+                       query_len=32)
+    results = {}
+    for tag, p in (("nopack", 1), ("pack", pack)):
+        cfg = base.replace(train=_dcp.replace(base.train, pack_pages=p))
+        _stamp(f"long-pack phase: building {tag} trainer (pack={p})")
+        tr = Trainer(cfg, corpus=corpus,
+                     workdir="/tmp/dnn_page_vectors_tpu_bench_long_pack")
+        state = tr.init_state()
+        step = tr.compiled_step(state)
+        it = iter(tr.batches())
+        batches = [next(it) for _ in range(2)]
+        rng = tr.base_rng()
+        for i in range(2):
+            state, m = step(state, batches[i % 2], rng)
+        hard_sync(m)
+        _stamp(f"long-pack {tag} compiled; timing")
+
+        def _loop():
+            nonlocal state
+            for i in range(psteps):
+                state, m = step(state, batches[i % 2], rng)
+            return m
+
+        pdt = _best_time(_loop, opt_reps)
+        pps = batch * psteps / pdt / n_dev
+        # useful flops: the pages' ACTUAL tokens (host-side, from batch 0)
+        page_tok_count = int((_npp.asarray(batches[0]["page"]) != 0).sum())
+        mean_tok = page_tok_count / batch
+        useful = 3.0 * (
+            encoder_flops_per_example(cfg.model, cfg.data.query_len)
+            + encoder_flops_per_example(cfg.model, int(round(mean_tok)))
+            + 2.0 * batch * cfg.model.out_dim)
+        results[tag] = (pps, (pps * useful / peak) if peak else None,
+                        mean_tok)
+        del state, step, batches
+
+    (np_pps, np_mfu, np_tok), (pk_pps, pk_mfu, pk_tok) = \
+        results["nopack"], results["pack"]
+    rec.update({
+        "long_pack_pages": pack,
+        "long_pack_mean_page_tokens": round(pk_tok, 1),
+        "long_nopack_pages_per_sec_per_chip": round(np_pps, 2),
+        "long_pack_pages_per_sec_per_chip": round(pk_pps, 2),
+        "long_pack_speedup": round(pk_pps / np_pps, 3),
+        "long_nopack_train_mfu": (round(np_mfu, 4)
+                                  if np_mfu is not None else None),
+        "long_pack_train_mfu": (round(pk_mfu, 4)
+                                if pk_mfu is not None else None),
+        "long_pack_mfu_gain": (round(pk_mfu / np_mfu, 3)
+                               if np_mfu and pk_mfu else None),
+    })
+    _stamp(f"long-pack phase done: {np_pps:.0f} -> {pk_pps:.0f} "
+           f"pages/s/chip ({pk_pps / np_pps:.2f}x via pack={pack})")
 
 
 def _long_t5(rec, n_dev, peak, lsteps, opt_reps, _best_time, _stamp) -> None:

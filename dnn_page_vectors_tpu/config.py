@@ -108,6 +108,25 @@ class TrainConfig:
     # per-dispatch host latency — the dominant single-chip overhead for
     # small models; log_every/checkpoint_every must be multiples of it.
     scan_steps: int = 1
+    # Fused/chunked contrastive loss (models/losses.py): >0 streams query
+    # rows against the global in-batch (+mined) negative pool this many
+    # rows at a time — logits + log-sum-exp + grad contribution per tile,
+    # never materializing the [B, B(1+H)] similarity matrix — so the
+    # effective negative pool scales with the global batch instead of
+    # with the biggest square matrix HBM can hold. Must divide
+    # batch_size. 0 = the dense reference path (byte-identical
+    # pre-chunking behavior); parity pinned by tests/test_losses_fused.py.
+    loss_chunk: int = 0
+    # Sequence packing for long-page configs (data/loader.py pack_segments,
+    # docs/MFU.md): >1 packs this many consecutive short pages into ONE
+    # [data.page_len] row with a segment mask (attention and pooling never
+    # cross pages; BERT positions restart per segment), so a corpus of
+    # short pages stops paying full-row pad compute. batch_size still
+    # counts PAGES; the compiled row batch is batch_size / pack_pages.
+    # Requires a transformer tower (bert/t5) with dense or flash
+    # attention. 1 = unpacked (byte-identical pre-packing behavior);
+    # parity pinned by tests/test_packing.py.
+    pack_pages: int = 1
     # PRNG implementation for the per-step dropout keys. "rbg" (XLA's
     # hardware RngBitGenerator) measured +22% train throughput over
     # "threefry2x32" on v5e — threefry mask generation is the single

@@ -123,6 +123,18 @@ class JsonlCorpus:
             out.append(getter(json.loads(line)) if v is None else v)
         return out
 
+    def page_lines(self, ids) -> list:
+        """Raw line buffers for the fused native extract+tokenize path
+        (SubwordTokenizer.encode_jsonl_lines): one seek+readline per
+        record and NOTHING else on the Python side — no field extract,
+        no bytes->str->bytes round trip."""
+        f = self._file()
+        out = []
+        for i in ids:
+            f.seek(int(self._offsets[int(i)]))
+            out.append(f.readline())
+        return out
+
     def page_texts(self, ids) -> list:
         return self._texts_bulk(ids, b'"page":', lambda r: r["page"])
 

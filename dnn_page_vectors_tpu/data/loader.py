@@ -66,6 +66,77 @@ def ordered_parallel_map(fn: Callable[[Any], Any], items: Iterable[Any],
         ex.shutdown(wait=False, cancel_futures=True)
 
 
+def _waterfill(lens: np.ndarray, cap: int) -> np.ndarray:
+    """Clip a row's page token-lengths to fit `cap` total: the classic
+    waterfilling threshold — largest pages lose tokens first, small pages
+    keep everything. Deterministic: threshold by binary search, leftover
+    slack dealt one token at a time to the longest pages (stable order)."""
+    lens = np.asarray(lens, np.int64)
+    total = int(lens.sum())
+    if total <= cap or lens.max(initial=0) == 0:
+        return lens.copy()
+    lo, hi = 0, int(lens.max())
+    while lo < hi:                      # largest T with sum(min(len,T))<=cap
+        mid = (lo + hi + 1) // 2
+        if int(np.minimum(lens, mid).sum()) <= cap:
+            lo = mid
+        else:
+            hi = mid - 1
+    out = np.minimum(lens, lo)
+    slack = cap - int(out.sum())
+    for i in np.argsort(-lens, kind="stable"):
+        if slack <= 0:
+            break
+        if lens[i] > out[i]:
+            out[i] += 1
+            slack -= 1
+    return out
+
+
+def pack_segments(enc: np.ndarray, pack: int
+                  ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sequence packing (train.pack_pages, docs/MFU.md): place `pack`
+    consecutive tokenized pages into ONE row of the same length.
+
+    enc: [B, L] int32 token ids, 0 = pad, tokens left-aligned (every
+    tokenizer in data/ pads only at the tail). B must divide by `pack`.
+    Returns (rows [B/pack, L], seg [B/pack, L], pos [B/pack, L]):
+      rows  the packed token ids — page s of row r is the byte-identical
+            token run of input page r*pack+s (clipped only when the row's
+            combined length overflows L, largest pages first — waterfill);
+      seg   segment ids, 0 = pad, s+1 on page s's tokens — the attention /
+            pooling mask consumed by the transformer towers;
+      pos   per-page LOCAL positions (0..len-1), so BERT's absolute
+            position embedding restarts for every packed page.
+
+    Everything is a pure function of the token lengths — deterministic,
+    and byte-identical to the unpacked tokens whenever the row fits
+    (pinned by tests/test_packing.py)."""
+    B, L = enc.shape[:2]
+    if enc.ndim != 2:
+        raise ValueError("pack_segments wants [B, L] subword/word ids; "
+                         "trigram [B, L, K] batches cannot pack")
+    if B % pack:
+        raise ValueError(f"batch of {B} pages must divide pack={pack}")
+    R = B // pack
+    rows = np.zeros((R, L), enc.dtype)
+    seg = np.zeros((R, L), np.int32)
+    pos = np.zeros((R, L), np.int32)
+    lens = (enc != 0).sum(axis=1)
+    for r in range(R):
+        budget = _waterfill(lens[r * pack:(r + 1) * pack], L)
+        c = 0
+        for s in range(pack):
+            n = int(budget[s])
+            if n == 0:
+                continue
+            rows[r, c:c + n] = enc[r * pack + s, :n]
+            seg[r, c:c + n] = s + 1
+            pos[r, c:c + n] = np.arange(n)
+            c += n
+    return rows, seg, pos
+
+
 def build_corpus(cfg: Config):
     d = cfg.data
     if d.corpus == "toy":
@@ -175,7 +246,8 @@ class TrainBatcher:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
                  workers: int = 1,
-                 profiler: Optional[PipelineProfiler] = None):
+                 profiler: Optional[PipelineProfiler] = None,
+                 pack: int = 1):
         if batch_size > corpus.num_pages:
             raise ValueError(
                 f"batch_size {batch_size} > corpus size {corpus.num_pages}: "
@@ -200,6 +272,14 @@ class TrainBatcher:
                 f"{self.process_count} (contiguous per-host slices)")
         self.workers = max(1, workers)
         self.profiler = profiler
+        # Sequence packing (train.pack_pages): each yielded batch carries
+        # batch_size PAGES in batch_size/pack packed page ROWS (+ the
+        # page_seg / page_pos mask arrays); the id schedule is untouched.
+        self.pack = max(1, pack)
+        if self.pack > 1 and (batch_size // self.process_count) % self.pack:
+            raise ValueError(
+                f"per-process batch {batch_size // self.process_count} must "
+                f"divide train.pack_pages={self.pack}")
 
     @property
     def steps_per_epoch(self) -> int:
@@ -237,6 +317,12 @@ class TrainBatcher:
                 "page": self.page_tok.encode_batch(pages),
                 "page_id": ids.astype(np.int32),
             }
+        if self.pack > 1:
+            with prof.stage("pack"):
+                rows, seg, pos = pack_segments(batch["page"], self.pack)
+            batch["page"] = rows
+            batch["page_seg"] = seg
+            batch["page_pos"] = pos
         if self.hard_negative_lookup is not None:
             neg_ids = self.hard_negative_lookup(ids)  # [B, H]
             flat = neg_ids.reshape(-1)
@@ -284,13 +370,31 @@ def iter_corpus_batches(corpus: ToyCorpus, page_tok, batch_size: int,
     truncating the stream."""
     stop = corpus.num_pages if stop is None else min(stop, corpus.num_pages)
     prof = profiler or _NULL_PROFILER
+    # Fused native extract+tokenize (docs/MFU.md "host pipeline"): when
+    # the corpus hands out raw jsonl lines and the tokenizer carries the
+    # C++ encoder, the per-record Python field extract and the UTF-8
+    # decode/re-encode round trip both disappear — the raw line buffer
+    # goes straight into token ids. Byte-identical to the plain path
+    # (tests/test_native.py); silently off when either side is missing.
+    fused = (getattr(page_tok, "encode_jsonl_lines", None) is not None
+             and getattr(corpus, "page_lines", None) is not None)
 
     def _make(s: int) -> Batch:
+        nonlocal fused
         ids = np.arange(s, min(s + batch_size, stop))
-        with prof.stage("read"):
-            pages = _page_texts(corpus, ids)
-        with prof.stage("tokenize"):
-            enc = page_tok.encode_batch(pages)
+        enc = None
+        if fused:
+            with prof.stage("read"):
+                lines = corpus.page_lines(ids)
+            with prof.stage("tokenize"):
+                enc = page_tok.encode_jsonl_lines(lines, "page")
+            if enc is None:      # no native encoder: stay on the plain path
+                fused = False
+        if enc is None:
+            with prof.stage("read"):
+                pages = _page_texts(corpus, ids)
+            with prof.stage("tokenize"):
+                enc = page_tok.encode_batch(pages)
         if len(ids) < batch_size:
             pad = batch_size - len(ids)
             enc = np.concatenate([enc, np.zeros((pad,) + enc.shape[1:], enc.dtype)])

@@ -290,6 +290,35 @@ class SubwordTokenizer:
                           file=sys.stderr)
         return np.stack([self.encode(t) for t in texts])
 
+    def encode_jsonl_lines(self, lines: Sequence[bytes],
+                           field: str = "page"):
+        """Fused jsonl-extract + batch encode (native/bpe_encode.cpp):
+        raw jsonl line buffers in, token ids out, with the per-record
+        field extract AND the UTF-8 decode/re-encode round trip both
+        gone from the Python side — the measured producer bound of the
+        bulk-embed sweep (docs/MFU.md "host pipeline"). Records the C++
+        extractor punts on (escapes, nesting, duplicate/missing key —
+        the same rules as data/jsonl.py _extract) fall back to
+        json.loads + the plain encoder, so results are byte-identical to
+        the unfused path (pinned by tests/test_native.py). Returns None
+        when the native encoder is unavailable — callers use the plain
+        read+tokenize path."""
+        native = self._native_encoder()
+        if native is None:
+            return None
+        key = f'"{field}":'.encode("utf-8")
+        out, status = native.encode_jsonl_batch(lines, key,
+                                                self.max_tokens, UNK_ID)
+        bad = np.flatnonzero(status == 0)
+        if bad.size:
+            texts = []
+            for i in bad:
+                rec = json.loads(lines[int(i)])
+                texts.append(rec[field] if field == "page"
+                             else rec.get(field, ""))
+            out[bad] = self.encode_batch(texts)
+        return out
+
     def tokens(self, text: str) -> List[str]:
         """Human-readable pieces with style-appropriate decoration (debug/tests)."""
         inv = {v: k for k, v in self.vocab.items()}

@@ -281,6 +281,7 @@ class BulkEmbedder:
                 else np.zeros((0, self.cfg.model.out_dim), np.float32))
 
     # -- the bulk job -----------------------------------------------------
+    # graftcheck: hot
     def embed_corpus(self, corpus: ToyCorpus, store: VectorStore,
                      batch_size: Optional[int] = None, resume: bool = True,
                      log: Optional[MetricsLogger] = None,
@@ -426,20 +427,30 @@ class BulkEmbedder:
                 def _collect(p):
                     nonlocal pages
                     with prof.stage("d2h"):
-                        ids = np.asarray(p[0]).reshape(-1)
-                        if q8:
-                            codes, scl = p[1]
-                            codes = np.asarray(codes)
-                            vec_acc.append(
-                                codes.reshape(-1, codes.shape[-1]))
-                            scl_acc.append(np.asarray(scl).reshape(-1))
-                        else:
-                            vecs = np.asarray(p[1])
-                            vec_acc.append(vecs.reshape(-1, vecs.shape[-1]))
+                        # ONE packed drain per dispatch: ids + vectors
+                        # (+ scales) materialize together instead of a
+                        # sequence of per-array np.asarray syncs — on a
+                        # tunneled/remote backend each sync is a full
+                        # round trip, and the drain rate (stage_d2h_bytes
+                        # over stage_d2h_s, reported as
+                        # embed_d2h_mbytes_per_sec) is what bounds the
+                        # from-text sweep (docs/MFU.md "host pipeline").
+                        host = jax.device_get(p)  # graftcheck: off=host-sync -- the one packed d2h drain per dispatch
+                    ids = host[0].reshape(-1)
+                    if q8:
+                        codes, scl = host[1]
+                        vec_acc.append(codes.reshape(-1, codes.shape[-1]))
+                        scl_acc.append(scl.reshape(-1))
+                        prof.add_bytes("d2h", ids.nbytes + codes.nbytes
+                                       + scl.nbytes)
+                    else:
+                        vecs = host[1]
+                        vec_acc.append(vecs.reshape(-1, vecs.shape[-1]))
+                        prof.add_bytes("d2h", ids.nbytes + vecs.nbytes)
                     ids_acc.append(ids)
-                    real = int((ids >= 0).sum())
-                    pages += real
-                    _m_pages.inc(real)
+                    real = (ids >= 0).sum()
+                    pages += int(real)
+                    _m_pages.inc(int(real))
 
                 for batch in prefetch_to_device(batches, sharding=sharding,
                                                 profiler=prof):
@@ -462,8 +473,16 @@ class BulkEmbedder:
         writer.close()   # join + re-raise any write failure
         _reg.gauge("embed.pages_per_sec_per_chip").set(
             pages / max(time.perf_counter() - t0, 1e-9) / n_dev)
+        # measured drain rate of the packed d2h transfers — the transport
+        # number the from-text sweep is bounded by (docs/MFU.md)
+        d2h_s = prof.stages().get("d2h", 0.0)
+        d2h_rate = (prof.stage_bytes().get("d2h", 0) / d2h_s / 1e6
+                    if d2h_s > 0 else 0.0)
+        _reg.gauge("embed.d2h_mbytes_per_sec").set(d2h_rate)
         if log:
-            rec = {"bulk_embed_pages": pages, **prof.summary()}
+            rec = {"bulk_embed_pages": pages,
+                   "embed_d2h_mbytes_per_sec": round(d2h_rate, 2),
+                   **prof.summary()}
             fc = faults.counters()
             if fc:     # recovery-path activity belongs next to the rate
                 rec["fault_counters"] = fc

@@ -8,6 +8,18 @@ all-gather (and the corresponding reduce-scatter in the backward pass) over
 ICI automatically — the gradient-correct global in-batch negatives that
 torch-DDP's NCCL hooks provided the reference (SURVEY.md §7 "hard parts")
 fall out of the partitioner with no user collective code.
+
+Two implementations of the same math (parity pinned by
+tests/test_losses_fused.py):
+  * dense (default) — materializes the [B, B(1+H)] logits; simple, fine
+    while the logits fit HBM next to the activations.
+  * chunked/fused (`chunk` > 0, train.loss_chunk) — streams query chunks
+    against the (GSPMD-gathered) global page pool, computing logits +
+    log-sum-exp + the gradient contribution one [chunk, B(1+H)] tile at a
+    time under jax.checkpoint, so live logits memory is O(chunk * pool)
+    instead of O(B * pool) in forward AND backward. This is what lets the
+    effective in-batch negative pool scale with the global batch instead
+    of with the largest square matrix HBM can hold.
 """
 from __future__ import annotations
 
@@ -23,12 +35,51 @@ def l2_normalize(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
     return x * jax.lax.rsqrt((x * x).sum(-1, keepdims=True) + eps)
 
 
+def _chunk_stats(rows: jnp.ndarray, pool: jnp.ndarray, labels: jnp.ndarray,
+                 scale: jnp.ndarray, chunk: int):
+    """Per-row softmax-CE statistics of `rows` scored against `pool`,
+    `chunk` rows at a time: returns (lse [N], pos [N], correct [N]).
+
+    This is the fused path's core: each lax.map step materializes only a
+    [chunk, M] logits tile in fp32 (M = pool rows), takes its log-sum-exp,
+    positive logit, and argmax hit, and drops it — the full [N, M]
+    similarity matrix never exists, in forward OR backward.
+    `jax.checkpoint` on the chunk body keeps the scan from saving each
+    tile as a residual: the backward pass recomputes the tile from the
+    (tiny) [chunk, D] inputs, so live logits memory stays O(chunk * M)
+    end to end. The softmax-CE value is exactly `lse - pos`, so the math
+    (and therefore the gradients autodiff derives) matches the dense
+    optax.softmax_cross_entropy_with_integer_labels to fp32 rounding.
+    """
+    n = rows.shape[0]
+    if n % chunk:
+        raise ValueError(
+            f"loss chunk {chunk} must divide the (per-direction) row count "
+            f"{n}: pick train.loss_chunk dividing train.batch_size")
+    nch = n // chunk
+
+    @jax.checkpoint
+    def one(pair):
+        rb, lb = pair
+        logits = scale * (rb @ pool.T)                  # [chunk, M] f32
+        lse = jax.nn.logsumexp(logits, axis=1)
+        pos = jnp.take_along_axis(logits, lb[:, None], axis=1)[:, 0]
+        correct = jnp.argmax(logits, axis=1) == lb
+        return lse, pos, correct
+
+    lse, pos, corr = jax.lax.map(
+        one, (rows.reshape(nch, chunk, rows.shape[-1]),
+              labels.reshape(nch, chunk)))
+    return lse.reshape(n), pos.reshape(n), corr.reshape(n)
+
+
 def cosine_contrastive_loss(
     q: jnp.ndarray,                       # [B, D] query vectors
     p: jnp.ndarray,                       # [B, D] gold page vectors
     scale: jnp.ndarray,                   # scalar inverse temperature
     neg: Optional[jnp.ndarray] = None,    # [B, H, D] mined hard negatives
     symmetric: bool = True,
+    chunk: int = 0,
 ) -> Tuple[jnp.ndarray, dict]:
     """Softmax contrastive loss over cosine similarities.
 
@@ -36,18 +87,48 @@ def cosine_contrastive_loss(
     in-batch page (global batch under GSPMD) plus, if given, all B*H mined
     hard negatives. `symmetric=True` adds the page->query direction (only
     over the in-batch block — mined negatives have no query side).
+
+    `chunk` > 0 selects the fused/chunked implementation
+    (train.loss_chunk): query rows are scored against the full negative
+    pool `chunk` rows at a time, with logits + log-sum-exp + the gradient
+    contribution computed per tile — the full [B, B(1+H)] similarity
+    matrix is never materialized in forward or backward, so the in-batch
+    negative pool can grow to whatever the *vectors* (not the logits) fit
+    in HBM. Under jit with the batch sharded over the mesh 'data' axis,
+    the page pool [B(1+H), D] is what GSPMD all-gathers across shards
+    (one small [B, D]-scale collective); each shard then streams its own
+    query chunks against the globally-gathered pool — every shard sees
+    the global negative pool, one chunk of logits at a time. Numerics:
+    identical math to the dense path (softmax-CE == lse - positive
+    logit), parity pinned to fp32 tolerance by tests/test_losses_fused.py.
+    0 (the default) keeps the dense reference path, byte-for-byte.
     """
     qn = l2_normalize(q)
     pn = l2_normalize(p)
+    B = q.shape[0]
+    labels = jnp.arange(B)
+    if chunk and 0 < chunk < B:
+        pool = pn
+        if neg is not None:
+            nn_ = l2_normalize(neg.reshape(-1, neg.shape[-1]))     # [B*H, D]
+            pool = jnp.concatenate([pn, nn_], axis=0)              # [B+BH, D]
+        lse, pos, corr = _chunk_stats(qn, pool, labels, scale, chunk)
+        loss_qp = (lse - pos).mean()
+        if symmetric:
+            lse_pq, pos_pq, _ = _chunk_stats(pn, qn, labels, scale, chunk)
+            loss = 0.5 * (loss_qp + (lse_pq - pos_pq).mean())
+        else:
+            loss = loss_qp
+        in_batch_acc = corr.mean()
+        return loss, {"loss": loss, "in_batch_acc": in_batch_acc,
+                      "scale": scale}
     logits = scale * (qn @ pn.T)                                   # [B, B]
     if neg is not None:
-        B = q.shape[0]
         nn_ = l2_normalize(neg.reshape(-1, neg.shape[-1]))         # [B*H, D]
         extra = scale * (qn @ nn_.T)                               # [B, B*H]
         logits_qp = jnp.concatenate([logits, extra], axis=1)       # [B, B+BH]
     else:
         logits_qp = logits
-    labels = jnp.arange(q.shape[0])
     loss_qp = optax.softmax_cross_entropy_with_integer_labels(
         logits_qp, labels).mean()
     if symmetric:

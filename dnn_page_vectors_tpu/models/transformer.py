@@ -59,7 +59,15 @@ class Attention(nn.Module):
     'seq' axis, parallel/ring_attention.py). For the T5 variant, dense/flash
     take the materialised rel_bias while ring takes rel_bias_table — the
     ring rebuilds its bias block per step from global positions instead of
-    ever holding the O(L²) bias."""
+    ever holding the O(L²) bias.
+
+    `seg` (sequence packing, train.pack_pages): [B, L] segment ids
+    (0 = pad, s >= 1 = packed page s) restrict attention to
+    within-segment pairs — dense builds the [B, L, L] block mask, flash
+    compares segment ids per score tile inside the kernel (no [B, L, L]
+    in HBM). The T5 rel_bias stays the GLOBAL-position bias: segments
+    are contiguous in the row, so within-segment relative distance
+    equals global distance, and cross-segment entries are masked."""
     num_heads: int
     model_dim: int
     use_bias: bool
@@ -70,7 +78,8 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, pad_mask: jnp.ndarray,
                  rel_bias: jnp.ndarray | None,
-                 rel_bias_table: jnp.ndarray | None = None) -> jnp.ndarray:
+                 rel_bias_table: jnp.ndarray | None = None,
+                 seg: jnp.ndarray | None = None) -> jnp.ndarray:
         head_dim = self.model_dim // self.num_heads
         B, L, _ = x.shape
         # Three separate projections, DELIBERATELY not fused into one [d,3d]
@@ -90,11 +99,15 @@ class Attention(nn.Module):
         if self.kind == "flash":
             from dnn_page_vectors_tpu.ops.flash_attention import flash_attention
             bias = None if rel_bias is None else rel_bias[0]  # [H, L, L]
-            out = flash_attention(bhld(q), bhld(k), bhld(v), pad_mask, bias)
+            out = flash_attention(bhld(q), bhld(k), bhld(v), pad_mask, bias,
+                                  seg=seg)
             out = bhld(out.astype(self.dtype))                # [B, L, H, Dh]
         elif self.kind == "ring":
             from dnn_page_vectors_tpu.parallel.ring_attention import ring_attention
             assert self.mesh is not None, "ring attention needs a mesh"
+            assert seg is None, \
+                "sequence packing (train.pack_pages) supports dense/flash " \
+                "attention only — the ring path shards L itself"
             # ring consumes the bias TABLE (rebuilt per step); a materialised
             # [1,H,L,L] bias here means a caller wired the wrong operand
             assert rel_bias is None, "ring attention takes rel_bias_table"
@@ -109,7 +122,15 @@ class Attention(nn.Module):
             if rel_bias is not None:
                 scores = scores + rel_bias
             big_neg = jnp.asarray(-1e9, jnp.float32)
-            scores = jnp.where(pad_mask[:, None, None, :], scores, big_neg)
+            if seg is None:
+                allowed = pad_mask[:, None, None, :]
+            else:
+                # block-diagonal segment mask: token i may attend j only
+                # inside its own packed page (and never to pad, seg 0)
+                allowed = ((seg[:, None, :] == seg[:, :, None])
+                           & (seg > 0)[:, None, :]
+                           & pad_mask[:, None, :])[:, None]   # [B,1,L,L]
+            scores = jnp.where(allowed, scores, big_neg)
             probs = nn.softmax(scores, axis=-1).astype(self.dtype)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(B, L, self.model_dim)
@@ -128,7 +149,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, rel_bias, rel_bias_table=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, seg=None):
         norm = (lambda n: RmsNorm(dtype=self.dtype, name=n)) if self.variant == "t5" \
             else (lambda n: nn.LayerNorm(dtype=self.dtype, name=n))
         use_bias = self.variant != "t5"
@@ -137,7 +158,7 @@ class Block(nn.Module):
         h = Attention(self.num_heads, self.model_dim, use_bias,
                       dtype=self.dtype, kind=self.attention_kind,
                       mesh=self.mesh, name="attn")(h, pad_mask, rel_bias,
-                                                   rel_bias_table)
+                                                   rel_bias_table, seg=seg)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         x = x + h
 
@@ -177,8 +198,23 @@ class TransformerEncoder(nn.Module):
     mesh: Any = None               # required for attention_kind='ring'
 
     @nn.compact
-    def __call__(self, ids: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+    def __call__(self, ids: jnp.ndarray, deterministic: bool = True,
+                 seg: jnp.ndarray | None = None,
+                 pos: jnp.ndarray | None = None,
+                 nseg: int = 0) -> jnp.ndarray:
         # ids: [B, L] subword ids, 0 = pad.
+        #
+        # Sequence packing (train.pack_pages, data/loader.py pack_segments):
+        # `seg` [B, L] marks which packed page each token belongs to
+        # (0 = pad, 1..nseg = page slot); attention is restricted to
+        # within-segment pairs and pooling runs PER SEGMENT, returning
+        # [B, nseg, D] — one vector per packed page. `pos` [B, L] gives
+        # per-segment LOCAL positions so BERT's absolute position
+        # embedding restarts at 0 for every packed page (the T5 relative
+        # bias needs no restart: segments are contiguous, so
+        # within-segment relative distance equals global distance and
+        # cross-segment entries are masked). seg=None is the unpacked
+        # path, byte-identical to pre-packing behavior: [B, D].
         B, L = ids.shape
         pad_mask = ids > 0
         x = nn.Embed(self.vocab_size, self.model_dim, dtype=self.dtype,
@@ -186,9 +222,12 @@ class TransformerEncoder(nn.Module):
         rel_bias = None
         rel_bias_table = None
         if self.variant == "bert":
-            pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                             (self.max_len, self.model_dim))
-            x = x + pos[:L].astype(self.dtype)[None]
+            pemb = self.param("pos_embed", nn.initializers.normal(0.02),
+                              (self.max_len, self.model_dim))
+            if pos is None:
+                x = x + pemb[:L].astype(self.dtype)[None]
+            else:
+                x = x + pemb[pos].astype(self.dtype)        # [B, L, d]
         else:
             # shared-across-layers relative position bias (T5 style)
             table = self.param("rel_bias", nn.initializers.normal(0.02),
@@ -198,8 +237,9 @@ class TransformerEncoder(nn.Module):
                 # block per step from global positions (ring_attention.py)
                 rel_bias_table = table
             else:
-                pos = jnp.arange(L)
-                buckets = _relative_position_bucket(pos[None, :] - pos[:, None])
+                gpos = jnp.arange(L)
+                buckets = _relative_position_bucket(
+                    gpos[None, :] - gpos[:, None])
                 rel_bias = table[buckets].transpose(2, 0, 1)[None]  # [1,H,L,L]
                 rel_bias = rel_bias.astype(jnp.float32)
         x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
@@ -208,9 +248,21 @@ class TransformerEncoder(nn.Module):
                       self.variant, self.dropout, dtype=self.dtype,
                       attention_kind=self.attention_kind, mesh=self.mesh,
                       name=f"block{i}")(x, pad_mask, rel_bias, rel_bias_table,
-                                        deterministic)
+                                        deterministic, seg=seg)
         x = (RmsNorm(dtype=self.dtype, name="ln_final") if self.variant == "t5"
              else nn.LayerNorm(dtype=self.dtype, name="ln_final"))(x)
+        if seg is not None:
+            # per-segment masked mean pool -> one vector per packed page
+            assert nseg > 0, "seg requires nseg (segments per packed row)"
+            onehot = (seg[:, :, None]
+                      == jnp.arange(1, nseg + 1)[None, None, :]
+                      ).astype(jnp.float32)                  # [B, L, S]
+            tot = jnp.einsum("bld,bls->bsd", x.astype(jnp.float32), onehot)
+            cnt = jnp.maximum(onehot.sum(1), 1.0)            # [B, S]
+            pooled = tot / cnt[..., None]
+            out = nn.Dense(self.out_dim, dtype=jnp.float32,
+                           name="proj")(pooled)
+            return out                                       # [B, S, D] f32
         # masked mean pool
         m = pad_mask[..., None].astype(jnp.float32)
         pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)

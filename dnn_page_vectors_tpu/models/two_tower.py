@@ -35,18 +35,44 @@ class TwoTower(nn.Module):
         return self.query_tower(ids, deterministic=deterministic)
 
     def encode_page(self, ids: jnp.ndarray,
-                    deterministic: bool = True) -> jnp.ndarray:
-        return self._page_enc()(ids, deterministic=deterministic)
+                    deterministic: bool = True,
+                    seg: jnp.ndarray | None = None,
+                    pos: jnp.ndarray | None = None,
+                    nseg: int = 0) -> jnp.ndarray:
+        """[R, L] ids -> [R, D], or — with a packed row's segment mask
+        `seg` [R, L] (+ per-segment local positions `pos`, see
+        data/loader.py pack_segments) — [R, nseg, D]: one vector per
+        packed page, attention and pooling never crossing segments."""
+        if seg is None:
+            return self._page_enc()(ids, deterministic=deterministic)
+        return self._page_enc()(ids, deterministic=deterministic,
+                                seg=seg, pos=pos, nseg=nseg)
 
     def scale(self) -> jnp.ndarray:
         return jnp.minimum(jnp.exp(self.log_scale), 100.0)
 
     def __call__(self, query_ids: jnp.ndarray, page_ids: jnp.ndarray,
                  neg_page_ids: jnp.ndarray | None = None,
-                 deterministic: bool = True):
-        """Returns (q_vec [B,D], p_vec [B,D], neg_vec [B,H,D] | None, scale)."""
+                 deterministic: bool = True,
+                 page_seg: jnp.ndarray | None = None,
+                 page_pos: jnp.ndarray | None = None):
+        """Returns (q_vec [B,D], p_vec [B,D], neg_vec [B,H,D] | None, scale).
+
+        With `page_seg` (sequence packing, train.pack_pages): `page_ids`
+        is [R, L] packed rows carrying B = query_ids.shape[0] pages total
+        (pack = B / R consecutive pages per row); the page tower returns
+        [R, pack, D] per-segment vectors, flattened back to [B, D] in the
+        same page order the unpacked batch would have produced."""
         q = self.encode_query(query_ids, deterministic)
-        p = self.encode_page(page_ids, deterministic)
+        if page_seg is not None:
+            B = query_ids.shape[0]
+            R = page_ids.shape[0]
+            assert B % R == 0, (B, R)
+            p = self.encode_page(page_ids, deterministic, seg=page_seg,
+                                 pos=page_pos, nseg=B // R)
+            p = p.reshape(B, p.shape[-1])
+        else:
+            p = self.encode_page(page_ids, deterministic)
         neg = None
         if neg_page_ids is not None:
             B, H = neg_page_ids.shape[:2]

@@ -80,6 +80,12 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32)]
     lib.dpv_bpe_encode_batch.restype = None
+    lib.dpv_bpe_encode_jsonl_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int8)]
+    lib.dpv_bpe_encode_jsonl_batch.restype = None
     return lib
 
 

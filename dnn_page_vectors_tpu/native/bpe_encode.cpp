@@ -148,4 +148,64 @@ void dpv_bpe_encode_batch(void* h, const char* texts, const int64_t* lens,
   }
 }
 
+// Fused jsonl-extract + encode (round 11): the bulk-embed producer's
+// measured Python bound is the per-record field extract + UTF-8
+// decode/re-encode round trip between the jsonl reader and this encoder
+// (~40% of single-worker producer time at synth-corpus shapes, see
+// docs/MFU.md "host pipeline"). This entry point takes the RAW jsonl
+// lines and does extract + greedy encode in one C++ pass, so the value
+// bytes go straight from the line buffer into token ids. Extraction
+// mirrors data/jsonl.py _extract's punt rules EXACTLY — any backslash,
+// a '{' past index 0 (nesting), missing or duplicate key, non-string
+// value, or no closing quote sets status[t] = 0 and the caller falls
+// back to json.loads for that record — so correctness never depends on
+// the fast path, only speed does.
+void dpv_bpe_encode_jsonl_batch(void* h, const char* lines,
+                                const int64_t* lens, int64_t n,
+                                const char* key, int64_t key_len,
+                                int32_t max_tokens, int32_t unk_id,
+                                int32_t* out, int8_t* status) {
+  const auto& v = *static_cast<BpeVocab*>(h);
+  std::vector<int32_t> offs;  // reused codepoint-offset scratch
+  int64_t off = 0;
+  const std::string_view k(key, static_cast<size_t>(key_len));
+  for (int64_t t = 0; t < n; ++t) {
+    const std::string_view line(lines + off, static_cast<size_t>(lens[t]));
+    off += lens[t];
+    status[t] = 0;
+    if (line.find('\\') != std::string_view::npos) continue;
+    if (line.find('{', 1) != std::string_view::npos) continue;
+    size_t j = line.find(k);
+    if (j == std::string_view::npos) continue;
+    if (line.find(k, j + k.size()) != std::string_view::npos) continue;
+    j += k.size();
+    while (j < line.size() && (line[j] == ' ' || line[j] == '\t')) ++j;
+    if (j >= line.size() || line[j] != '"') continue;
+    ++j;
+    const size_t e = line.find('"', j);
+    if (e == std::string_view::npos) continue;
+    status[t] = 1;
+    const char* text = line.data() + j;
+    const int64_t text_len = static_cast<int64_t>(e - j);
+    int32_t* row = out + t * max_tokens;
+    int32_t pos = 0;
+    int64_t i = 0;
+    while (i < text_len && pos < max_tokens) {
+      int cl;
+      while (i < text_len &&
+             is_space_cp(decode_cp(text + i, text_len - i, &cl))) {
+        i += cl;
+      }
+      if (i >= text_len) break;
+      int64_t start = i;
+      while (i < text_len &&
+             !is_space_cp(decode_cp(text + i, text_len - i, &cl))) {
+        i += cl;
+      }
+      pos += encode_word(v, text + start, i - start, unk_id,
+                         max_tokens - pos, row + pos, offs);
+    }
+  }
+}
+
 }  // extern "C"

@@ -30,7 +30,7 @@ class NativeBpeEncoder:
             blob, len(blob),
             ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(ids))
 
-    def encode_batch(self, texts: Sequence[str], max_tokens: int,
+    def encode_batch(self, texts: Sequence, max_tokens: int,
                      unk_id: int) -> np.ndarray:
         n = len(texts)
         out = np.zeros((n, max_tokens), dtype=np.int32)
@@ -39,8 +39,11 @@ class NativeBpeEncoder:
         # surrogatepass: a lone surrogate (e.g. a "\ud800" JSON escape)
         # must encode rather than raise; C++ decodes it back to one
         # codepoint, finds no piece, and emits UNK — exactly the Python
-        # path's behavior for that character.
-        blobs = [t.encode("utf-8", "surrogatepass") for t in texts]
+        # path's behavior for that character. Items may already BE utf-8
+        # bytes (the jsonl raw-field fast path) — those skip the str
+        # round trip entirely.
+        blobs = [t if isinstance(t, bytes)
+                 else t.encode("utf-8", "surrogatepass") for t in texts]
         lens = np.asarray([len(b) for b in blobs], dtype=np.int64)
         concat = b"".join(blobs)
         _lib.dpv_bpe_encode_batch(
@@ -48,6 +51,30 @@ class NativeBpeEncoder:
             n, max_tokens, unk_id,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         return out
+
+    def encode_jsonl_batch(self, lines: Sequence[bytes], key: bytes,
+                           max_tokens: int, unk_id: int
+                           ) -> tuple[np.ndarray, np.ndarray]:
+        """Fused jsonl-extract + encode (bpe_encode.cpp): `lines` are raw
+        jsonl line buffers; C++ pulls `key`'s string value (same punt
+        rules as data/jsonl.py _extract) and greedy-encodes it in one
+        pass. Returns (ids [n, max_tokens], status [n] int8) — status 0
+        rows were punted (escapes / nesting / duplicate or missing key)
+        and must be filled by the caller's json.loads fallback."""
+        n = len(lines)
+        out = np.zeros((n, max_tokens), dtype=np.int32)
+        status = np.zeros(n, dtype=np.int8)
+        if n == 0:
+            return out, status
+        lens = np.asarray([len(b) for b in lines], dtype=np.int64)
+        concat = b"".join(lines)
+        _lib.dpv_bpe_encode_jsonl_batch(
+            self._h, concat,
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, key, len(key), max_tokens, unk_id,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+        return out, status
 
     def __del__(self):
         h = getattr(self, "_h", None)

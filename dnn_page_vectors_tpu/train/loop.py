@@ -45,9 +45,14 @@ class TrainState:
     step: jnp.ndarray          # int32 scalar
 
 
-def make_train_step(model, tx):
+def make_train_step(model, tx, loss_chunk: int = 0):
     """Build the (un-jitted) global-batch train step; caller jits with
-    shardings + donation."""
+    shardings + donation.
+
+    `loss_chunk` > 0 selects the fused/chunked contrastive loss
+    (train.loss_chunk, models/losses.py): the [B, B(1+H)] logits never
+    materialize — per-chunk log-sum-exp tiles stream against the
+    GSPMD-gathered global page pool instead."""
 
     def train_step(state: TrainState, batch: Dict[str, jnp.ndarray],
                    base_rng: jax.Array) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
@@ -57,8 +62,11 @@ def make_train_step(model, tx):
             q, p, neg, scale = model.apply(
                 params, batch["query"], batch["page"],
                 batch.get("neg_page"), deterministic=False,
-                rngs={"dropout": rng})
-            return cosine_contrastive_loss(q, p, scale, neg)
+                rngs={"dropout": rng},
+                page_seg=batch.get("page_seg"),
+                page_pos=batch.get("page_pos"))
+            return cosine_contrastive_loss(q, p, scale, neg,
+                                           chunk=loss_chunk)
 
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -150,7 +158,8 @@ class Trainer:
     # -- compiled step ----------------------------------------------------
     def compiled_step(self, state: TrainState):
         if self._compiled is None:
-            step_fn = make_train_step(self.model, self.tx)
+            step_fn = make_train_step(self.model, self.tx,
+                                      loss_chunk=self.cfg.train.loss_chunk)
             state_sh = jax.tree_util.tree_map(lambda x: x.sharding, state)
             self._compiled = jax.jit(
                 step_fn,
@@ -164,12 +173,25 @@ class Trainer:
     def _make_batcher(self, start_step: int,
                       profiler: Optional[PipelineProfiler] = None
                       ) -> TrainBatcher:
+        pack = max(1, self.cfg.train.pack_pages)
+        if pack > 1:
+            if self.cfg.model.encoder not in ("bert", "t5"):
+                raise ValueError(
+                    "train.pack_pages needs a transformer page tower "
+                    f"(bert/t5), not {self.cfg.model.encoder!r}: segment "
+                    "masks only exist for attention encoders")
+            rows = self.cfg.train.batch_size // pack
+            if rows % self.mesh.shape["data"]:
+                raise ValueError(
+                    f"packed row batch {rows} (batch_size/pack_pages) must "
+                    f"divide the mesh data axis {self.mesh.shape['data']}")
         return TrainBatcher(
             self.corpus, self.query_tok, self.page_tok,
             batch_size=self.cfg.train.batch_size, seed=self.cfg.train.seed,
             start_step=start_step,
             hard_negative_lookup=self.hard_negative_lookup,
-            workers=self.cfg.data.tokenize_workers, profiler=profiler)
+            workers=self.cfg.data.tokenize_workers, profiler=profiler,
+            pack=pack)
 
     def batches(self, start_step: int = 0,
                 profiler: Optional[PipelineProfiler] = None) -> Iterator[Any]:
@@ -204,7 +226,8 @@ class Trainer:
         counter advances inside the scan); metrics returned are the LAST
         step's, matching what a per-step loop would log at the boundary."""
         if self._compiled_multi is None:
-            step_fn = make_train_step(self.model, self.tx)
+            step_fn = make_train_step(self.model, self.tx,
+                                      loss_chunk=self.cfg.train.loss_chunk)
 
             def multi(state, stacked, base_rng):
                 def body(st, batch):

@@ -48,11 +48,24 @@ class PipelineProfiler:
         self._lock = threading.Lock()
         self._sec: Dict[str, float] = {}
         self._n: Dict[str, int] = {}
+        self._bytes: Dict[str, int] = {}
 
     def add(self, name: str, seconds: float) -> None:
         with self._lock:
             self._sec[name] = self._sec.get(name, 0.0) + seconds
             self._n[name] = self._n.get(name, 0) + 1
+
+    def add_bytes(self, name: str, nbytes: int) -> None:
+        """Byte volume moved by a stage (h2d/d2h transfers): with the
+        stage's cumulative seconds this makes the achieved MB/s of a
+        transfer stage computable from one metrics line —
+        `embed_d2h_mbytes_per_sec` in the bulk-embed log and bench."""
+        with self._lock:
+            self._bytes[name] = self._bytes.get(name, 0) + int(nbytes)
+
+    def stage_bytes(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._bytes)
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -66,6 +79,7 @@ class PipelineProfiler:
         with self._lock:
             self._sec.clear()
             self._n.clear()
+            self._bytes.clear()
 
     def stages(self) -> Dict[str, float]:
         """{stage: cumulative seconds} snapshot."""
@@ -87,6 +101,8 @@ class PipelineProfiler:
             for k in sorted(self._sec):
                 out[f"{prefix}{k}_s"] = round(self._sec[k], 4)
                 out[f"{prefix}{k}_n"] = self._n.get(k, 0)
+                if k in self._bytes:
+                    out[f"{prefix}{k}_bytes"] = self._bytes[k]
             return out
 
 

@@ -216,3 +216,81 @@ def test_native_fuzz_equality_random_unicode():
     want = np.stack([sub.encode(t) for t in texts])
     got = sub.encode_batch(texts)
     np.testing.assert_array_equal(got, want)
+
+
+def test_bpe_fused_jsonl_matches_plain_path(tmp_path):
+    """Round 11 (MFU campaign): the fused C++ jsonl-extract+encode
+    (dpv_bpe_encode_jsonl_batch) must be byte-identical to the plain
+    read->extract->decode->encode path, including every punt rule —
+    escapes, nesting, duplicate keys, missing field, non-string value —
+    where it falls back to json.loads per record."""
+    import json
+
+    tok, _ = _trained_subword("wordpiece")
+    assert tok._native_encoder() is not None
+
+    lines = [
+        b'{"query": "q", "page": "hello world"}\n',
+        b'{"query": "q", "page": "esc \\" aped"}\n',          # escape: punt
+        b'{"page": "first", "page": "second"}\n',             # dup: punt
+        b'{"obj": {"page": "inner"}, "page": "outer"}\n',     # nest: punt
+        b'{"query": "only a query"}\n',                       # missing
+        b'{"page": 42}\n',                                    # non-string
+        '{"page": "ünïcôdé wörds 日本語"}\n'.encode("utf-8"),
+        b'{"page": "   spaced   out   "}\n',
+        b'{"page": ""}\n',
+    ]
+
+    def plain(field):
+        out = []
+        for ln in lines:
+            rec = json.loads(ln)
+            out.append(rec[field] if field == "page" and field in rec
+                       else rec.get(field, ""))
+        return tok.encode_batch(out)
+
+    # records 4/5 have no usable "page": plain path would KeyError on a
+    # strict read, so compare on the well-formed subset for "page"...
+    ok_lines = [ln for ln in lines if b'"page": 42' not in ln
+                and b"only a query" not in ln]
+    got = tok.encode_jsonl_lines(ok_lines, "page")
+    want = tok.encode_batch([json.loads(ln)["page"] for ln in ok_lines])
+    np.testing.assert_array_equal(got, want)
+
+    # the "query" field exercises the .get fallback for missing keys
+    gotq = tok.encode_jsonl_lines(lines, "query")
+    wantq = tok.encode_batch([json.loads(ln).get("query", "")
+                              for ln in lines])
+    np.testing.assert_array_equal(gotq, wantq)
+
+
+def test_fused_jsonl_through_iter_corpus_batches(tmp_path):
+    """iter_corpus_batches takes the fused path automatically for a
+    JsonlCorpus + subword tokenizer and yields byte-identical batches to
+    the plain read+tokenize path."""
+    from dnn_page_vectors_tpu.data.jsonl import JsonlCorpus
+    from dnn_page_vectors_tpu.data.loader import iter_corpus_batches
+
+    path = tmp_path / "c.jsonl"
+    corpus0 = ToyCorpus(num_pages=200, seed=3)
+    with open(path, "w") as f:
+        for i in range(200):
+            import json as _json
+            f.write(_json.dumps({"query": corpus0.query_text(i),
+                                 "page": corpus0.page_text(i)}) + "\n")
+    corpus = JsonlCorpus(str(path))
+    tok, _ = _trained_subword("wordpiece")
+    assert tok._native_encoder() is not None
+
+    fused = [b["page"] for b in iter_corpus_batches(corpus, tok, 64)]
+
+    class _NoLines:                     # same corpus, fused path disabled
+        num_pages = corpus.num_pages
+
+        def page_texts(self, ids):
+            return corpus.page_texts(ids)
+
+    plain = [b["page"] for b in iter_corpus_batches(_NoLines(), tok, 64)]
+    assert len(fused) == len(plain)
+    for a, b in zip(fused, plain):
+        np.testing.assert_array_equal(a, b)

@@ -1,0 +1,182 @@
+"""Sequence-packing parity pins (train.pack_pages; data/loader.py
+pack_segments, the segment-masked transformer towers, and the flash
+kernel's in-VMEM segment compare).
+
+The contract: packing is a LAYOUT change, not a math change — when the
+packed pages fit their row, the tokens are byte-identical to the unpacked
+batch and the training loss curve matches the unpacked run to float
+tolerance; attention and pooling never leak across packed pages.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.data.loader import TrainBatcher, pack_segments
+from dnn_page_vectors_tpu.data.toy import ToyCorpus
+from dnn_page_vectors_tpu.train.loop import Trainer
+
+pytestmark = pytest.mark.mfu
+
+
+def _enc(lens, L, base=1):
+    """Left-aligned fake token rows with the given non-pad lengths."""
+    out = np.zeros((len(lens), L), np.int32)
+    for i, n in enumerate(lens):
+        out[i, :n] = np.arange(base, base + n) + 100 * i
+    return out
+
+
+def test_pack_segments_tokens_byte_identical():
+    enc = _enc([5, 3, 7, 2, 4, 6, 1, 0], L=32)
+    rows, seg, pos = pack_segments(enc, pack=4)
+    assert rows.shape == seg.shape == pos.shape == (2, 32)
+    for r in range(2):
+        c = 0
+        for s in range(4):
+            n = int((enc[r * 4 + s] != 0).sum())
+            tokens = rows[r, c:c + n]
+            # byte-identical token run, correctly labeled and positioned
+            assert (tokens == enc[r * 4 + s, :n]).all()
+            assert (seg[r, c:c + n] == s + 1).all()
+            assert (pos[r, c:c + n] == np.arange(n)).all()
+            c += n
+        assert (rows[r, c:] == 0).all() and (seg[r, c:] == 0).all()
+
+
+def test_pack_segments_waterfill_clips_largest_first():
+    # combined 5+14+3+10 = 32 > L=16: waterfilling finds the threshold
+    # T=4 (sum(min(len,4))=15), everything above the water line clips to
+    # it, pages below keep every token, and the one slack token goes to
+    # the LONGEST page — deterministic result [4, 5, 3, 4], exactly
+    # filling the row. The longest page loses the most tokens.
+    enc = _enc([5, 14, 3, 10], L=16)
+    rows, seg, pos = pack_segments(enc, pack=4)
+    kept = [int((seg[0] == s + 1).sum()) for s in range(4)]
+    assert kept == [4, 5, 3, 4]
+    # every clipped run is still a PREFIX of the original tokens
+    c = int(kept[0])
+    assert (rows[0, c:c + kept[1]] == enc[1, :kept[1]]).all()
+
+
+def test_pack_segments_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="divide"):
+        pack_segments(_enc([3, 3, 3], L=16), pack=2)
+    with pytest.raises(ValueError, match="trigram"):
+        pack_segments(np.zeros((4, 8, 3), np.int32), pack=2)
+
+
+def _trainer(tmp_path, pack, attention="dense", tag=""):
+    cfg = get_config("bert_mini_v5p16", {
+        "data.num_pages": 512, "data.vocab_size": 512,
+        "data.page_len": 96, "data.query_len": 12,
+        "model.num_layers": 2, "model.attention": attention,
+        "model.dropout": 0.0,
+        "train.batch_size": 32, "train.pack_pages": pack,
+        "train.log_every": 1000,
+    })
+    # pages of ~4 words tokenize well under 96/4 tokens: no truncation,
+    # so packed tokens must be byte-identical to the unpacked batch
+    corpus = ToyCorpus(num_pages=512, seed=0, page_len=4, query_len=8)
+    return Trainer(cfg, corpus=corpus,
+                   workdir=str(tmp_path / f"pack{pack}{attention}{tag}"))
+
+
+def test_packed_batch_matches_unpacked_tokens(tmp_path):
+    t1 = _trainer(tmp_path, 1)
+    t4 = _trainer(tmp_path, 4)
+    b1 = next(iter(t1._make_batcher(0)))
+    b4 = next(iter(t4._make_batcher(0)))
+    assert (b1["query"] == b4["query"]).all()
+    assert (b1["page_id"] == b4["page_id"]).all()
+    assert b4["page"].shape[0] == b1["page"].shape[0] // 4
+    # page s of packed row r == unpacked page r*4+s, byte for byte
+    for r in range(b4["page"].shape[0]):
+        for s in range(4):
+            n = int((b1["page"][r * 4 + s] != 0).sum())
+            run = b4["page"][r][b4["page_seg"][r] == s + 1]
+            assert (run == b1["page"][r * 4 + s, :n]).all()
+
+
+def test_packed_training_matches_unpacked_loss_curve(tmp_path):
+    curves = {}
+    for pack in (1, 4):
+        tr = _trainer(tmp_path, pack)
+        state = tr.init_state()
+        step = tr.compiled_step(state)
+        it = iter(tr.batches())
+        rng = tr.base_rng()
+        curve = []
+        for _ in range(3):
+            state, m = step(state, next(it), rng)
+            curve.append(float(m["loss"]))
+        curves[pack] = curve
+    diff = np.abs(np.array(curves[1]) - np.array(curves[4])).max()
+    assert diff < 1e-3, curves
+
+
+def test_packed_encoder_no_cross_page_leak(tmp_path):
+    """Changing page B's tokens must not move page A's vector when the two
+    are packed into one row — the segment mask is airtight."""
+    tr = _trainer(tmp_path, 2, tag="leak")
+    state = tr.init_state()
+    model = tr.model
+    L = tr.cfg.data.page_len
+    rng = np.random.default_rng(0)
+    a = rng.integers(2, 400, size=8).astype(np.int32)
+    b1 = rng.integers(2, 400, size=10).astype(np.int32)
+    b2 = rng.integers(2, 400, size=10).astype(np.int32)
+
+    def packed_row(second):
+        enc = np.zeros((2, L), np.int32)
+        enc[0, :len(a)] = a
+        enc[1, :len(second)] = second
+        rows, seg, pos = pack_segments(enc, pack=2)
+        return (jnp.asarray(rows), jnp.asarray(seg), jnp.asarray(pos))
+
+    def vecs(second):
+        rows, seg, pos = packed_row(second)
+        return model.apply(state.params, rows, method="encode_page",
+                           seg=seg, pos=pos, nseg=2)
+
+    v1 = np.asarray(vecs(b1))
+    v2 = np.asarray(vecs(b2))
+    assert np.abs(v1[0, 0] - v2[0, 0]).max() < 1e-5   # page A unmoved
+    assert np.abs(v1[0, 1] - v2[0, 1]).max() > 1e-3   # page B moved
+
+
+def test_packed_flash_matches_dense(tmp_path):
+    """The flash kernel's in-kernel segment compare == the dense [B,L,L]
+    segment mask, through the full packed train step."""
+    curves = {}
+    for attention in ("dense", "flash"):
+        tr = _trainer(tmp_path, 4, attention=attention)
+        state = tr.init_state()
+        step = tr.compiled_step(state)
+        it = iter(tr.batches())
+        rng = tr.base_rng()
+        curve = []
+        for _ in range(2):
+            state, m = step(state, next(it), rng)
+            curve.append(float(m["loss"]))
+        curves[attention] = curve
+    diff = np.abs(np.array(curves["dense"]) - np.array(curves["flash"])).max()
+    assert diff < 5e-3, curves
+
+
+def test_packing_rejects_non_transformer_towers(tmp_path):
+    cfg = get_config("cdssm_toy", {
+        "data.num_pages": 256, "train.batch_size": 32,
+        "train.pack_pages": 2})
+    corpus = ToyCorpus(num_pages=256, seed=0)
+    tr = Trainer(cfg, corpus=corpus, workdir=str(tmp_path))
+    with pytest.raises(ValueError, match="transformer"):
+        tr._make_batcher(0)
+
+
+def test_batcher_rejects_misaligned_pack():
+    corpus = ToyCorpus(num_pages=64, seed=0)
+    with pytest.raises(ValueError, match="pack_pages"):
+        TrainBatcher(corpus, None, None, batch_size=30, pack=4,
+                     process_index=0, process_count=1)

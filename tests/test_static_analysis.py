@@ -492,6 +492,32 @@ def test_repo_has_no_unsuppressed_findings():
     assert all(s.get("reason") for s in r.suppressed)
 
 
+def test_bulk_embed_sweep_is_host_sync_scoped():
+    """Round 11 (MFU campaign): the bulk-embed sweep is `# graftcheck:
+    hot`, so an accidental per-array `.item()`/`np.asarray` sync added
+    inside the new packed-d2h pipeline fails `cli lint`. Pinned two ways:
+    the annotation exists on embed_corpus (the repo's ONE packed
+    device_get shows up as a reasoned host-sync suppression), and an
+    accidental sync inserted into an identically-annotated loop is a
+    finding."""
+    r = analyze(root=_REPO)
+    assert any(s["path"].endswith("infer/bulk_embed.py")
+               and s["rule"] == "host-sync" and s.get("reason")
+               for s in r.suppressed), (
+        "embed_corpus lost its hot annotation (or its packed-d2h pragma)")
+    findings = analyze_source(
+        "import numpy as np\n"
+        "# graftcheck: hot\n"
+        "def embed_sweep(batches):\n"
+        "    out = []\n"
+        "    for b in batches:\n"
+        "        out.append(np.asarray(b))\n"
+        "    return out\n",
+        "pkg/infer/sweep.py")
+    assert _rules(findings, "host-sync"), \
+        "np.asarray inside a hot embed loop must be a host-sync finding"
+
+
 def test_analyzer_is_stdlib_only():
     """The lint path must run on a jax-less box: no jax/numpy imports
     anywhere under tools/analyze (the subprocess tests above strip
