@@ -97,11 +97,20 @@ def _previous_bench_record() -> dict | None:
 # cache hit rate) regresses by dropping. Ratio-vs-previous keys and
 # metadata are excluded: they re-derive from the gated keys anyway.
 # compact_* contract values scale with the injected tombstone count (a
-# protocol constant), not with performance — excluded like the p99 target
+# protocol constant), not with performance — excluded like the p99 target.
+# partitioned_* protocol constants (store geometry, the routing drill's
+# fixed shed count) are excluded the same way; the phase's MEASURED keys
+# gate with their suffixes: p99 (_ms) and scan bytes (_bytes) regress by
+# rising, qps / scaling-efficiency keys by dropping, and "shed" joins the
+# lower-is-better tokens so routing-health counts flag like latency.
 _GATE_SKIP = {"vs_baseline", "attempts", "slo_p99_target_ms",
-              "compact_bytes_reclaimed", "compact_dead_rows_dropped"}
+              "compact_bytes_reclaimed", "compact_dead_rows_dropped",
+              "partitioned_store_rows", "partitioned_shards",
+              "partitioned_dim", "partitioned_k", "partitioned_iters",
+              "partitioned_shed_drill_sheds",
+              "partitioned_shed_drill_degraded_serves"}
 _LOWER_IS_BETTER = ("_ms", "seconds", "imbalance", "error", "_bytes",
-                    "lint_")
+                    "lint_", "shed")
 
 
 def _lower_is_better(key: str) -> bool:
@@ -1466,6 +1475,195 @@ def _long_t5(rec, n_dev, peak, lsteps, opt_reps, _best_time, _stamp) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Partitioned-serving phase (docs/SCALING.md "Partitioned serving").
+#
+# HOST-SIMULATED BY DESIGN: the scatter-gather's partition workers stand in
+# for P serving hosts, so this phase runs on the CPU backend in its own
+# subprocess — it produces real measured numbers even when the TPU is
+# unreachable (the device phases stay null-honest), and on a TPU round its
+# keys merge into the same record. Scaling is accounted the only honest way
+# a one-box simulation of P hosts can be: each partition's local top-k runs
+# SEQUENTIALLY and is timed individually, and the simulated per-query
+# latency is the critical path max(partition seconds) + the measured merge
+# fold (PartitionSet.simulate) — wall-clock thread concurrency on a shared
+# core would measure the box, not the topology. Scan bytes per query are
+# the critical-path partition's candidate payload, measured by the same
+# accounting serving itself reports.
+# ---------------------------------------------------------------------------
+
+def run_partitioned_worker() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+
+    dim = int(os.environ.get("BENCH_PART_DIM", "64"))
+    shard_rows = int(os.environ.get("BENCH_PART_SHARD_ROWS", "16384"))
+    n_shards = int(os.environ.get("BENCH_PART_SHARDS", "8"))
+    iters = int(os.environ.get("BENCH_PART_ITERS", "12"))
+    kq = 10
+    rows = shard_rows * n_shards
+    _stamp(f"partitioned phase: building {rows}-row synthetic store "
+           f"({n_shards} shards, dim {dim})")
+    rng = np.random.default_rng(0)
+    sdir = "/tmp/dnn_page_vectors_tpu_bench/part_store"
+    import shutil
+    shutil.rmtree(sdir, ignore_errors=True)
+    store = VectorStore(sdir, dim=dim, shard_size=shard_rows)
+    for si in range(n_shards):
+        v = rng.standard_normal((shard_rows, dim)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(si * shard_rows,
+                                        (si + 1) * shard_rows,
+                                        dtype=np.int64), v)
+    store = VectorStore(sdir)
+
+    class _MeshOnly:
+        """The partitioned phase drives retrieval by pre-computed query
+        vectors (SearchService.topk_vectors), so the embedder stub only
+        needs the mesh — no model, tokenizer, or checkpoint."""
+
+    emb = _MeshOnly()
+    emb.mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+    qv = rng.standard_normal((1, dim)).astype(np.float32)
+    qv /= np.linalg.norm(qv, axis=1, keepdims=True)
+
+    rec = {"partitioned_store_rows": rows, "partitioned_shards": n_shards,
+           "partitioned_dim": dim, "partitioned_k": kq,
+           "partitioned_iters": iters}
+    # Build EVERY topology first, then INTERLEAVE the timed rounds: the
+    # sandbox's shared-tenancy noise comes and goes on a minutes scale,
+    # so measuring P=1 and P=4 in different minutes would let one slow
+    # window misprice the scaling ratio — round-robin sampling puts every
+    # topology under the same noise, and the MEDIAN critical path is the
+    # robust per-topology estimator on top.
+    combos = [(P, R) for P in (1, 2, 4) for R in (1, 2)]
+    services = {}
+    for P, R in combos:
+        cfg = get_config("cdssm_toy", {
+            "model.out_dim": dim, "serve.partitions": P,
+            "serve.replicas": R})
+        svc = SearchService(cfg, emb, None, store, preload_hbm_gb=4.0)
+        pset = svc.partition_set
+        extra = None
+        if pset is None:
+            # P=R=1: the single-view path IS the baseline — simulate
+            # through a 1-partition set for identical accounting
+            from dnn_page_vectors_tpu.infer.partition import PartitionSet
+            extra = pset = PartitionSet(svc, store, partitions=1,
+                                        replicas=1)
+        pset.simulate(qv, 1, kq)               # warm: compile every shape
+        services[(P, R)] = (svc, pset, extra)
+    stats = {key: {"crit": [], "merge": [], "scan": 0, "ids": None}
+             for key in combos}
+    for _ in range(iters):
+        for key in combos:
+            sim = services[key][1].simulate(qv, 1, kq)
+            st = stats[key]
+            st["crit"].append(sim["critical_path_seconds"])
+            st["merge"].append(sim["merge_seconds"])
+            st["scan"] = max(sim["scan_bytes"])
+            st["ids"] = sim["ids"]
+    qps = {}
+    scan = {}
+    base_ids = stats[(1, 1)]["ids"]
+    for P, R in combos:
+        st = stats[(P, R)]
+        if not np.array_equal(st["ids"], base_ids):
+            rec["partitioned_identity_error"] = f"P={P} R={R}"
+        # BEST critical path -> qps (the _best_time estimator the train/
+        # embed phases use): shared-tenancy interference only ever ADDS
+        # time, so min is the honest "what the topology can do" number;
+        # the p99 key next to it reports the observed spread
+        qps[(P, R)] = 1.0 / float(np.min(np.asarray(st["crit"])))
+        scan[(P, R)] = st["scan"]
+        rec[f"partitioned_qps_p{P}_r{R}"] = round(qps[(P, R)], 2)
+        rec[f"partitioned_p99_ms_p{P}_r{R}"] = round(
+            float(np.percentile(np.asarray(st["crit"]), 99)) * 1000.0, 3)
+        rec[f"partitioned_scan_bytes_per_query_p{P}_r{R}"] = st["scan"]
+        rec[f"partitioned_merge_ms_p{P}_r{R}"] = round(
+            sum(st["merge"]) / len(st["merge"]) * 1000.0, 4)
+        _stamp(f"partitioned P={P} R={R}: "
+               f"{qps[(P, R)]:.1f} sim qps, "
+               f"{st['scan']} scan B/query")
+        svc, _, extra = services[(P, R)]
+        if extra is not None:
+            extra.close()
+        svc.close()
+    for P in (2, 4):
+        rec[f"partitioned_scaling_efficiency_p{P}"] = round(
+            qps[(P, 1)] / qps[(1, 1)] / P, 4)
+    rec["partitioned_scan_bytes_ratio_p4"] = round(
+        scan[(4, 1)] / max(scan[(1, 1)], 1), 4)
+
+    # routing drill (fixed protocol, excluded from the gate): a restaging
+    # primary sheds to its replica; a partition with EVERY replica
+    # degraded serves degraded locally — results stay non-empty and
+    # identical (the availability half of the acceptance criteria)
+    cfg = get_config("cdssm_toy", {"model.out_dim": dim,
+                                   "serve.partitions": 2,
+                                   "serve.replicas": 2})
+    svc = SearchService(cfg, emb, None, store, preload_hbm_gb=4.0)
+    pset = svc.partition_set
+    pset._parts[0][0].set_restaging(True)
+    svc.topk_vectors(qv, k=kq)
+    pset._parts[0][0].set_restaging(False)
+    for rep in pset._parts[0]:
+        rep.view.stream_entries = list(rep.view.entries)
+        rep.view.shards = None
+    _, ids = svc.topk_vectors(qv, k=kq)
+    rec["partitioned_shed_drill_sheds"] = svc.replica_shed
+    rec["partitioned_shed_drill_degraded_serves"] = \
+        svc.partition_degraded_serves
+    rec["partitioned_degraded_results_identical"] = bool(
+        np.array_equal(ids, base_ids))
+    svc.close()
+    print(json.dumps(rec), flush=True)
+
+
+def _run_partitioned() -> dict:
+    """Run the host-simulated partitioned phase in a CPU subprocess and
+    return its keys (merged into whatever record the wrapper prints —
+    including the backend-unreachable null record, which is the point:
+    this sandbox produces real numbers for the partitioned phase)."""
+    if os.environ.get("BENCH_PARTITIONED", "1") == "0":
+        return {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--partitioned-worker"],
+            capture_output=True, text=True,
+            timeout=int(os.environ.get("BENCH_PARTITIONED_TIMEOUT_S",
+                                       "600")),
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "partitioned_store_rows" in rec:
+                return rec
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"partitioned_error":
+                (" | ".join(tail[-3:]) if tail
+                 else f"rc={proc.returncode}")[:300]}
+    except subprocess.TimeoutExpired:
+        return {"partitioned_error": "partitioned worker timed out"}
+    except Exception as e:  # noqa: BLE001 — the phase never costs a round
+        return {"partitioned_error": f"{type(e).__name__}: {e}"[:300]}
+
+
+# ---------------------------------------------------------------------------
 # Wrapper: retry the worker while the backend is down; never leak a traceback
 # as the only output.
 # ---------------------------------------------------------------------------
@@ -1506,8 +1704,7 @@ def main() -> None:
                 # a nonzero rc after that can only come from optional work
                 if proc.returncode != 0:
                     rec.setdefault("long_error", f"worker rc={proc.returncode}")
-                _print_delta_table(rec, _previous_bench_record())
-                print(json.dumps(rec))
+                _finalize(rec)
                 return
             tail = (proc.stderr or proc.stdout or "").strip().splitlines()
             last_err = " | ".join(tail[-3:]) if tail else f"rc={proc.returncode}"
@@ -1522,8 +1719,7 @@ def main() -> None:
             if rec is not None:
                 rec.setdefault("long_error",
                                f"timed out after {attempt_s}s")
-                _print_delta_table(rec, _previous_bench_record())
-                print(json.dumps(rec))
+                _finalize(rec)
                 return
             # surface the worker's progress stamps so the hung stage is named
             err = e.stderr or b""
@@ -1537,14 +1733,33 @@ def main() -> None:
         time.sleep(delay)
         delay = min(delay * 2, 120.0)
     # Persistent failure: one parseable JSON line, rc 0 (VERDICT r1 #1).
-    print(json.dumps({
+    # The host-simulated partitioned phase still runs (CPU subprocess):
+    # its measured keys ride the null record, so this sandbox re-seeds the
+    # partitioned regression baseline even with the TPU unreachable.
+    rec = {
         "metric": METRIC, "value": None, "unit": UNIT, "vs_baseline": None,
         "error": last_err[-500:], "attempts": attempt,
-    }))
+    }
+    rec.update(_run_partitioned())
+    print(json.dumps(rec))
+
+
+def _finalize(rec: dict) -> None:
+    """Merge the host-simulated partitioned phase into the worker record,
+    re-run the regression gate over the full key set, and print the final
+    record (the one the driver parses)."""
+    rec.update(_run_partitioned())
+    prev = _previous_bench_record()
+    _, regs = _regression_gate(rec, prev)
+    rec["regressions"] = regs
+    _print_delta_table(rec, prev)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         run_worker()
+    elif "--partitioned-worker" in sys.argv:
+        run_partitioned_worker()
     else:
         main()

@@ -208,6 +208,16 @@ def main(argv=None) -> None:
     ap.add_argument("--iters", type=int, default=4,
                     help="loadtest: bisection steps after the doubling "
                          "phase brackets the p99 cliff")
+    ap.add_argument("--partitions", type=int, default=None, metavar="P",
+                    help="loadtest/search: serve.partitions override — "
+                         "split the store into P contiguous partitions "
+                         "behind the scatter-gather (docs/SCALING.md "
+                         "'Partitioned serving'); the report gains a "
+                         "per-partition qps/p99/shed block")
+    ap.add_argument("--replicas", type=int, default=None, metavar="R",
+                    help="loadtest/search: serve.replicas override — R "
+                         "health-routed copies of every partition "
+                         "(shorthand for --set serve.replicas=R)")
     ap.add_argument("--mutate-every", dest="mutate_every", type=float,
                     default=None, metavar="S",
                     help="loadtest: hot-swap refresh() every S seconds of "
@@ -277,6 +287,14 @@ def main(argv=None) -> None:
         import dataclasses as _dc
         cfg = cfg.replace(serve=_dc.replace(cfg.serve, index="ivf",
                                             nprobe=args.nprobe))
+    if args.partitions is not None or args.replicas is not None:
+        import dataclasses as _dc
+        over = {}
+        if args.partitions is not None:
+            over["partitions"] = max(1, args.partitions)
+        if args.replicas is not None:
+            over["replicas"] = max(1, args.replicas)
+        cfg = cfg.replace(serve=_dc.replace(cfg.serve, **over))
 
     # fault injection (only when a plan is configured) + the always-on
     # transient-I/O retry policy — every command goes through this
@@ -760,6 +778,18 @@ def main(argv=None) -> None:
                 "full_rebuilds": final_met["full_rebuilds"],
                 "tombstone_density": final_met["tombstone_density"],
                 "reclaimable_bytes": final_met["reclaimable_bytes"],
+            })
+        if svc.partition_set is not None:
+            # partitioned topology + routing health (docs/SCALING.md):
+            # per-partition qps/p99/shed/degraded-serve counts, plus the
+            # service-level routing counters
+            part_met = svc.metrics()
+            report.update({
+                "serve_partitions": part_met["serve_partitions"],
+                "serve_replicas": part_met["serve_replicas"],
+                "replica_shed": part_met["replica_shed"],
+                "partition_degraded": part_met["partition_degraded"],
+                "partitions": part_met["partitions"],
             })
         svc.close()
         report.update({

@@ -247,6 +247,28 @@ class ServeConfig:
     # build so their per-request host gather disappears; the non-resident
     # tail falls back to the mmap path. 0 disables.
     hot_postings_gb: float = 0.0
+    # Partitioned serving (infer/partition.py, docs/SCALING.md
+    # "Partitioned serving"): >1 splits the store's shard table into this
+    # many contiguous partitions, each owning its shard range, its slice
+    # of the IVF posting lists, and its cut of serve.hot_postings_gb;
+    # search_many scatter-gathers — the coalesced bucket broadcasts once,
+    # every partition answers its local top-k over ONLY its rows, and
+    # results fold through the ops/topk.py partition merge tree. Clamped
+    # to the shard count. 1 (with replicas=1) keeps the single-view
+    # serving path byte-identical to before.
+    partitions: int = 1
+    # Replica sets: R copies of every partition (each host-simulated as a
+    # worker thread owning an independent _ServeView), with health-based
+    # routing — a replica mid-restage, degraded to the streaming path, or
+    # past its queue budget sheds traffic to its siblings (`replica_shed`
+    # event); a partition whose replicas are ALL degraded serves degraded
+    # locally (`partition_degraded`), never an empty result slice.
+    replicas: int = 1
+    # Queue-depth shed budget per partition replica: a replica with more
+    # than this many requests in flight stops being preferred and traffic
+    # sheds to its siblings. Only a routing preference — with every
+    # replica over budget the least-loaded healthy one still serves.
+    replica_shed_queue: int = 8
 
 
 @dataclasses.dataclass(frozen=True)

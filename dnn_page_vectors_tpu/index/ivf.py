@@ -633,6 +633,22 @@ class IVFIndex:
             raise IndexUnavailable(
                 f"IVF index file {name} {err}; rebuild the index")
 
+    # -- partitioned serving (infer/partition.py, docs/SCALING.md) ---------
+    def partition_view(self, shard_indices) -> "IVFIndex":
+        """A serving view of this index restricted to one partition's
+        shard range: the same manifest, centroids, and PQ codec, but ONLY
+        the listed shards' posting files — so `search` gathers candidates
+        from exactly the partition's slice of the inverted file and
+        `stage_hot` pins only its rows. The centroid scan stays global
+        (the [nlist, D] matrix is tiny and identical everywhere); the
+        per-list candidate accounting (`list_sizes`, `stats`) is fresh
+        and partition-local. Mmap caches are lazy per view, so a
+        partition never touches a sibling's shard files."""
+        keep = {int(s) for s in shard_indices}
+        return IVFIndex(self.store, self.manifest, self.centroids,
+                        {s: p for s, p in self._postings.items()
+                         if s in keep}, pq=self.pq)
+
     # -- search ------------------------------------------------------------
     def _shard_raw(self, sidx: int):
         raw = self._raw.get(sidx)

@@ -41,6 +41,27 @@ an exact re-rank, and `serve.hot_postings_gb` stages the hot posting
 set's codes to device at view build time — resident lists answer with
 zero per-request host gather (`ann_gather_bytes` measures what moves).
 
+Partitioned + replicated serving (docs/SCALING.md "Partitioned serving"):
+`serve.partitions` > 1 splits the shard table into P contiguous
+partitions — each owning its shard range, its slice of the IVF posting
+lists, and its cut of the hot-posting HBM budget — host-simulated as
+per-partition worker threads each owning an independent `_ServeView`
+(infer/partition.py). search_many becomes a scatter-gather: the coalesced
+bucket's query matrix broadcasts once, every partition answers its local
+top-k over only its rows (per-query scan bytes drop ~1/P, partitions run
+concurrently), and results fold through the ops/topk.py partition merge
+tree (`merge_topk_host` as the final host fold). `serve.replicas` adds R
+copies of each partition with health-based routing: a replica mid-restage,
+degraded to the streaming path, or past `serve.replica_shed_queue` sheds
+to its siblings (`replica_shed` event); a partition whose replicas are
+ALL degraded serves degraded locally (`partition_degraded`) — never an
+empty result slice. refresh() restages partition by partition (one
+partition's restage — or maintenance swapping in compaction/rebuild
+results — never blocks the others) and publishes the finished view table
+with one atomic reference assignment, so a scatter never mixes store
+generations across partitions. P = R = 1 (the default) keeps the
+single-view paths below byte-identical.
+
 HBM pre-staging: when the store fits the configured budget, every shard is
 device_put once (row-sharded over the mesh 'data' axis, padded to one
 static shape so a single compiled top-k program serves all shards) and
@@ -315,11 +336,18 @@ class _ServeView:
     __slots__ = ("store", "entries", "generation", "shards", "shard_keys",
                  "stream_entries", "pid_table", "merge", "pad_rows",
                  "index", "index_error", "index_info", "docs_appended",
-                 "tombstoned", "num_vectors", "maint_stats")
+                 "tombstoned", "num_vectors", "maint_stats", "restricted")
 
-    def __init__(self, store: VectorStore):
+    def __init__(self, store: VectorStore,
+                 entries: Optional[List[Dict]] = None):
         self.store = store
-        self.entries: List[Dict] = store.shards()   # frozen table snapshot
+        # frozen table snapshot — the whole store, or (partitioned
+        # serving, infer/partition.py) one partition's contiguous shard
+        # range; `restricted` routes the streaming sweep through THIS
+        # entry subset instead of the live table
+        self.entries: List[Dict] = (store.shards() if entries is None
+                                    else list(entries))
+        self.restricted = entries is not None
         self.generation = store.generation
         self.docs_appended = store.appended_vectors()
         self.tombstoned = store.tombstoned_count()
@@ -435,6 +463,18 @@ class SearchService:
                            if serve_cfg is not None else 0)
         self._hot_gb = (getattr(serve_cfg, "hot_postings_gb", 0.0)
                         if serve_cfg is not None else 0.0)
+        # partitioned + replicated serving (infer/partition.py,
+        # docs/SCALING.md "Partitioned serving"): P x R host-simulated
+        # partition workers behind the scatter-gather; 1 x 1 keeps the
+        # single-view path below byte-identical
+        self._partitions = (getattr(serve_cfg, "partitions", 1)
+                            if serve_cfg is not None else 1)
+        self._replicas = (getattr(serve_cfg, "replicas", 1)
+                          if serve_cfg is not None else 1)
+        self._shed_queue = (getattr(serve_cfg, "replica_shed_queue", 8)
+                            if serve_cfg is not None else 8)
+        self._m_replica_shed = reg.counter("serve.replica_shed")
+        self._m_partition_degraded = reg.counter("serve.partition_degraded")
         upd_cfg = getattr(cfg, "updates", None)
         self._rebuild_drift = (getattr(upd_cfg, "rebuild_drift", 0.25)
                                if upd_cfg is not None else 0.25)
@@ -487,7 +527,19 @@ class SearchService:
         self.warm_latency_ms: Optional[float] = None
         self._preload_gb = preload_hbm_gb
         self._refresh_lock = threading.Lock()   # one refresh at a time
-        self._view = self._build_view(store)
+        self._pset = None
+        if self._partitions * self._replicas > 1:
+            from dnn_page_vectors_tpu.infer.partition import PartitionSet
+            self._pset = PartitionSet(self, store,
+                                      partitions=self._partitions,
+                                      replicas=self._replicas,
+                                      shed_queue=self._shed_queue)
+            # the control view: partition 0's primary — store-level fields
+            # (generation, maint stats) are identical on every view; the
+            # compat windows (_shards/_index) read partition 0's slice
+            self._view = self._pset.primary_view()
+        else:
+            self._view = self._build_view(store)
         self.registry.gauge("serve.degraded").set(
             1.0 if self.degraded else 0.0)
         self.registry.gauge("serve.store_generation").set(
@@ -580,6 +632,24 @@ class SearchService:
     def restage_forced(self) -> int:
         return self._m_restage_forced.value
 
+    # partitioned-serving routing counters (docs/SCALING.md): shed =
+    # traffic moved off a partition's primary replica (restaging /
+    # degraded / over queue budget); partition_degraded = a partition
+    # whose replicas were ALL degraded served degraded locally instead of
+    # returning an empty slice
+    @property
+    def replica_shed(self) -> int:
+        return self._m_replica_shed.value
+
+    @property
+    def partition_degraded_serves(self) -> int:
+        return self._m_partition_degraded.value
+
+    @property
+    def partition_set(self):
+        """The live PartitionSet (None on a single-view service)."""
+        return self._pset
+
     @contextlib.contextmanager
     def _stage(self, name: str, **attrs):
         """One serving stage, observed twice from one clock: cumulative
@@ -659,6 +729,7 @@ class SearchService:
         new one, and a failed index update degrades THAT view to exact
         search instead of taking the service down."""
         t0 = time.perf_counter()
+        part_info = None
         with self._refresh_lock:
             old = self._view
             # fresh handle: verify() gates appended bytes exactly like the
@@ -666,10 +737,21 @@ class SearchService:
             new_store = VectorStore(self.store.directory)
             upd = (self._auto_update_index if update_index is None
                    else update_index)
-            view = self._build_view(new_store, reuse=old,
-                                    update_index=upd)
-            t_swap = time.perf_counter()
-            self._view = view        # THE swap: one reference assignment
+            if self._pset is not None:
+                # partitioned: a ROLLING per-partition swap — while one
+                # partition restages (its router sheds to a replica), the
+                # others keep serving their current views untouched; the
+                # store-level IVF update runs exactly once, on the first
+                # view built (infer/partition.py)
+                t_swap = time.perf_counter()
+                part_info = self._pset.refresh(new_store, update_index=upd)
+                view = self._pset.primary_view()
+                self._view = view
+            else:
+                view = self._build_view(new_store, reuse=old,
+                                        update_index=upd)
+                t_swap = time.perf_counter()
+                self._view = view    # THE swap: one reference assignment
             self.store = new_store
             self._m_refreshes.inc()
         swap_ms = (time.perf_counter() - t_swap) * 1000.0
@@ -690,6 +772,10 @@ class SearchService:
             info["index_update"] = view.index_info
         if view.index_error is not None:
             info["index_error"] = view.index_error
+        if part_info is not None:
+            # per-partition rolling-swap record (docs/SCALING.md): which
+            # partition restaged when, and each replica's swap window
+            info["partitions"] = part_info
         # lifecycle event (docs/OBSERVABILITY.md): the hot-swap is the
         # transition dashboards alert on; trace-id correlation ties it to
         # the request that observed it when refresh runs under a trace
@@ -709,8 +795,13 @@ class SearchService:
         return info
 
     def _build_view(self, store: VectorStore, reuse: "_ServeView" = None,
-                    update_index: bool = False) -> "_ServeView":
-        view = _ServeView(store)
+                    update_index: bool = False,
+                    entries: Optional[List[Dict]] = None,
+                    hot_gb: Optional[float] = None) -> "_ServeView":
+        """One serving view over `store` — the whole shard table, or
+        (partitioned serving) the `entries` subset with `hot_gb` as this
+        partition's cut of the hot-posting HBM budget."""
+        view = _ServeView(store, entries=entries)
         # dead-byte accounting as registry gauges (docs/MAINTENANCE.md):
         # the compaction trigger's inputs ride the same exposition as
         # every other serving number (metrics(), cli serve-metrics)
@@ -742,7 +833,11 @@ class SearchService:
             if not view.shards:       # nothing survived staging
                 view.shards = None    # stream instead; handles empty stores
         if self._serve_index == "ivf":
-            self._attach_index(view, update_index)
+            self._attach_index(
+                view, update_index,
+                shard_indices=([e["index"] for e in view.entries]
+                               if view.restricted else None),
+                hot_gb=hot_gb)
             if (reuse is not None and reuse.index_error is not None
                     and view.index is not None):
                 # a degraded-to-exact view healed across the refresh
@@ -752,8 +847,11 @@ class SearchService:
         return view
 
     # -- IVF ANN index (docs/ANN.md, docs/UPDATES.md) ----------------------
-    def _attach_index(self, view: "_ServeView", update_index: bool) -> None:
+    def _attach_index(self, view: "_ServeView", update_index: bool,
+                      shard_indices: Optional[List[int]] = None,
+                      hot_gb: Optional[float] = None) -> None:
         from dnn_page_vectors_tpu.index.ivf import IndexUnavailable, IVFIndex
+        hot_gb = self._hot_gb if hot_gb is None else hot_gb
         try:
             if update_index:
                 serve_cfg = self.cfg.serve
@@ -779,15 +877,21 @@ class SearchService:
             else:
                 view.index = IVFIndex.open(view.store)
             view.index_error = None
+            if view.index is not None and shard_indices is not None:
+                # partitioned serving: THIS view searches only its slice
+                # of the inverted file — posting gathers, ADC code reads,
+                # and the hot staging below all see the partition's
+                # shards and nothing else (index/ivf.py partition_view)
+                view.index = view.index.partition_view(shard_indices)
             if (view.index is not None and view.index.pq is not None
-                    and self._hot_gb > 0):
+                    and hot_gb > 0):
                 # HBM-resident hot posting set (docs/ANN.md): staged per
                 # VIEW — a refresh re-opens the index, so the staged codes
                 # (and their tombstone masks) follow the same hot-swap
                 # cadence as the staged store shards. A staging failure
                 # costs the residency, never the index.
                 try:
-                    hot = view.index.stage_hot(self._hot_gb * 2 ** 30)
+                    hot = view.index.stage_hot(hot_gb * 2 ** 30)
                     if view.index_info is not None:
                         view.index_info = {**view.index_info, **hot}
                 except Exception as e:  # noqa: BLE001
@@ -814,14 +918,14 @@ class SearchService:
             faults.warn(f"IVF index update failed ({view.index_error}); "
                         "serving the exact path until a rebuild")
 
-    def _search_ann(self, view: "_ServeView", qv: np.ndarray, n: int, k: int,
-                    nprobe: Optional[int] = None
-                    ) -> Optional[List[List[Dict]]]:
-        """ANN answer for `n` real queries, or None to fall back to the
-        exact path (index missing, stale against the view store's CURRENT
-        model step, or failing at search time — the failure quarantine
-        already happened inside the index layer). `nprobe` overrides the
-        serve.nprobe default per request (mixed-profile load tests)."""
+    def _ann_topk(self, view: "_ServeView", qv: np.ndarray, n: int, k: int,
+                  nprobe: Optional[int] = None):
+        """ANN (scores [n, k], page_ids [n, k], scan_bytes) for `n` real
+        queries, or None to fall back to the exact path (index missing,
+        stale against the view store's CURRENT model step, or failing at
+        search time — the failure quarantine already happened inside the
+        index layer). `nprobe` overrides the serve.nprobe default per
+        request (mixed-profile load tests)."""
         idx = view.index
         if idx is None or idx.model_step != view.store.model_step:
             return None
@@ -856,8 +960,8 @@ class SearchService:
         self._m_ann_lists.inc(st.get("lists_scanned", 0))
         self._m_ann_reranked.inc(st.get("candidates_reranked", 0))
         self._m_ann_gather.inc(st.get("gather_bytes", 0))
-        with self._stage("format"):
-            return [self._format(scores[i], ids[i]) for i in range(n)]
+        return (np.asarray(scores, np.float32), np.asarray(ids, np.int64),
+                int(st.get("gather_bytes", 0)))
 
     def _stage_view(self, view: "_ServeView", rows: int,
                     budget_bytes: float, per_row: int,
@@ -1142,6 +1246,8 @@ class SearchService:
         if self._maintenance is not None:
             self._maintenance.close()
             self._maintenance = None
+        if self._pset is not None:
+            self._pset.close()
         if self._batcher is not None:
             self._batcher.close()
             # telemetry survives the thread: metrics() after close still
@@ -1199,6 +1305,16 @@ class SearchService:
         if sizes:
             rec["serve_batches"] = len(sizes)
             rec["serve_mean_batch"] = round(sum(sizes) / len(sizes), 2)
+        if self._pset is not None:
+            # partitioned-serving topology + routing health
+            # (docs/SCALING.md): per-partition/replica qps, p99, queue
+            # depth, shed and degraded-serve counts — the loadtest report
+            # and dashboards read this block as-is
+            rec["serve_partitions"] = self._pset.partitions
+            rec["serve_replicas"] = self._pset.replicas
+            rec["replica_shed"] = self.replica_shed
+            rec["partition_degraded"] = self.partition_degraded_serves
+            rec["partitions"] = self._pset.stats()
         if self._serve_index != "exact":
             # ANN counters + the active index config (the PR 3
             # cache-counter pattern: flat keys, always present when the
@@ -1348,14 +1464,53 @@ class SearchService:
                      n: int, k: int,
                      nprobe: Optional[int] = None) -> List[List[Dict]]:
         qv = self._embed_queries_cached(queries)
+        if self._pset is not None:
+            # partitioned scatter-gather (infer/partition.py): the
+            # coalesced bucket's query matrix broadcasts ONCE to every
+            # partition; each answers its local top-k over only its shard
+            # range, results fold through the partition merge tree
+            best_s, best_i = self._pset.topk(qv, n, k, nprobe)
+        else:
+            best_s, best_i, _ = self._topk_view(view, qv, n, k, nprobe)
+        with self._stage("format"):
+            return [self._format(best_s[i], best_i[i]) for i in range(n)]
+
+    def topk_vectors(self, qv: np.ndarray, k: Optional[int] = None,
+                     nprobe: Optional[int] = None
+                     ) -> tuple:
+        """Raw retrieval for PRE-COMPUTED query vectors: (scores [n, k]
+        fp32, page_ids [n, k] int64, -1-padded), skipping tokenize/encode
+        and snippet formatting. The bench's host-simulated partitioned
+        phase and vector-level tests drive the full serving top-k
+        (partitioned or single-view) through this without a model."""
+        k = k or self.cfg.eval.recall_k
+        qv = np.asarray(qv, np.float32)
+        n = qv.shape[0]
+        if self._pset is not None:
+            return self._pset.topk(qv, n, k, nprobe)
+        s, i, _ = self._topk_view(self._view, qv, n, k, nprobe)
+        return s, i
+
+    def _topk_view(self, view: "_ServeView", qv: np.ndarray, n: int, k: int,
+                   nprobe: Optional[int] = None):
+        """Raw top-k of `n` real query rows of `qv` over ONE view:
+        (scores [n, k] fp32, page_ids [n, k] int64, scan_bytes). This is
+        the per-partition unit of work of the scatter-gather — a
+        partition worker runs it over its own restricted view — and the
+        whole retrieval of the single-view path. `scan_bytes` is the
+        candidate payload this view scanned to answer: the ANN gather
+        bytes, or the view's full row bytes on the exact path — the
+        per-partition critical-path byte count the partitioned bench
+        phase records (drops ~1/P under partitioning)."""
         if self._serve_index == "ivf":
-            res = self._search_ann(view, qv, n, k, nprobe)
+            res = self._ann_topk(view, qv, n, k, nprobe)
             if res is not None:
                 return res
             # exact path serves this request; visible in metrics + counters
             self._m_ann_fallbacks.inc(n)
             faults.count("serve_ann_fallbacks", n)
         B = self.query_batch
+        row_bytes = view.store.row_bytes
         if view.shards is None:
             # streaming store: pad the query matrix to a bucket multiple so
             # every call reuses one compiled shape, then sweep disk ONCE
@@ -1363,30 +1518,37 @@ class SearchService:
             # refresh() never mutates it (it opens a fresh handle for the
             # next view), so a swap mid-sweep cannot mix generations, while
             # an in-place store mutation (ensure_model_step under a live
-            # service) still propagates per request like it always did
+            # service) still propagates per request like it always did.
+            # A RESTRICTED (partition) view sweeps its frozen entry subset
+            # instead — its shard range is the ownership contract.
+            qp = qv[:n]
             pad = (-n) % B
             if pad:
-                qv = np.concatenate(
-                    [qv, np.zeros((pad, qv.shape[1]), np.float32)])
+                qp = np.concatenate(
+                    [qp, np.zeros((pad, qp.shape[1]), np.float32)])
             self._note_dispatch_shape("topk_over_store", batch=B, k=k)
             with self._stage("topk", path="streaming"):
-                scores, ids = topk_over_store(qv, view.store,
-                                              self.embedder.mesh, k=k,
-                                              query_batch=B)
-            with self._stage("format"):
-                return [self._format(scores[i], ids[i]) for i in range(n)]
+                scores, ids = topk_over_store(
+                    qp, view.store, self.embedder.mesh, k=k, query_batch=B,
+                    entries=view.entries if view.restricted else None)
+            scan = sum(e["count"] for e in view.entries) * row_bytes
+            return scores[:n], ids[:n], scan
         # Two passes over the buckets: dispatch them ALL first (the merge
         # output stays on device — JAX's async queue runs bucket i+1's
         # top-k while bucket i's packed transfer drains), THEN materialize
-        # and format in order. A >bucket batch therefore pipelines compute
-        # against transfer instead of serializing dispatch/drain per
-        # bucket.
-        pending = [self._dispatch_bucket(view, qv[s: s + B], k)
+        # in order. A >bucket batch therefore pipelines compute against
+        # transfer instead of serializing dispatch/drain per bucket.
+        pending = [(s, self._dispatch_bucket(view, qv[s: s + B], k))
                    for s in range(0, n, B)]
-        out: List[List[Dict]] = []
-        for nreal, q, packed in pending:
-            out.extend(self._collect_bucket(view, nreal, q, packed, k))
-        return out
+        out_s = np.full((n, k), -np.inf, np.float32)
+        out_i = np.full((n, k), -1, np.int64)
+        for s0, (nreal, q, packed) in pending:
+            bs, bi = self._collect_bucket(view, nreal, q, packed, k)
+            out_s[s0: s0 + nreal] = bs[:nreal]
+            out_i[s0: s0 + nreal] = bi[:nreal]
+        scan = (sum(nv for _, nv, _, _ in view.shards)
+                + sum(e["count"] for e in view.stream_entries)) * row_bytes
+        return out_s, out_i, scan
 
     # graftcheck: hot
     def _dispatch_bucket(self, view: "_ServeView", qblock: np.ndarray,
@@ -1421,7 +1583,11 @@ class SearchService:
 
     # graftcheck: hot
     def _collect_bucket(self, view: "_ServeView", nreal: int, q, packed,
-                        k: int) -> List[List[Dict]]:
+                        k: int):
+        """Drain one dispatched bucket to host (scores [nreal, k] fp32,
+        page_ids [nreal, k] int64) — formatting happens once per call in
+        _search_view, so the partitioned scatter-gather can fold raw
+        per-partition candidates before any snippet work."""
         with self._stage("merge"):
             # graftcheck: off=host-sync -- THE one packed d2h per
             # bucket: the whole point of the merged [B, 2k] layout
@@ -1430,18 +1596,16 @@ class SearchService:
         top_i = packed[:, k:]
         pids = np.where(top_i >= 0,
                         view.pid_table[np.clip(top_i, 0, None)], -1)
+        best_s = np.where(np.isfinite(top_s), top_s, -np.inf).astype(
+            np.float32)
+        best_i = pids.astype(np.int64)
         if not view.stream_entries:
-            with self._stage("format"):
-                return [self._format(top_s[i], pids[i])
-                        for i in range(nreal)]
+            return best_s[:nreal], best_i[:nreal]
         # degraded tail: shards that failed to stage are re-read from disk
         # — ONCE for the whole bucket, prefetched one shard ahead on a
         # reader thread — and folded into the resident results through the
         # same merge_shard_topk the streaming path uses: identical results,
         # per-bucket disk reads for exactly the failed shards
-        best_s = np.where(np.isfinite(top_s), top_s, -np.inf).astype(
-            np.float32)
-        best_i = pids.astype(np.int64)
 
         def _load_tail():
             for entry in view.stream_entries:
@@ -1462,8 +1626,7 @@ class SearchService:
                 best_s, best_i = merge_shard_topk(
                     q, pages, ids, nrows, self.embedder.mesh, k,
                     best_s, best_i, scales=scales)
-        with self._stage("format"):
-            return [self._format(best_s[i], best_i[i]) for i in range(nreal)]
+        return best_s[:nreal], best_i[:nreal]
 
     def _format(self, scores, ids) -> List[Dict]:
         return [

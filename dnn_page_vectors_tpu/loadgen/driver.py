@@ -187,6 +187,13 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         "events": [{"event": e["event"], "attrs": e["attrs"],
                     "trace_id": e.get("trace_id")} for e in events],
     }
+    if "partitions" in m:
+        # partitioned serving (docs/SCALING.md): the per-partition
+        # qps/p99/shed block + routing counters ride each trial record,
+        # so a p99 excursion attributes to the partition that shed
+        rec["partitions"] = m["partitions"]
+        rec["replica_shed"] = m.get("replica_shed", 0)
+        rec["partition_degraded"] = m.get("partition_degraded", 0)
     if schedule_digest is not None:
         rec["schedule_digest"] = schedule_digest
     if mutator is not None:

@@ -223,6 +223,11 @@ class MaintenanceService:
         if self._svc is not None:
             info = self._svc.refresh()
             stats["refresh_swap_ms"] = info.get("swap_ms")
+            if "partitions" in info:
+                # partitioned service (docs/SCALING.md): the compacted
+                # base rolled in partition by partition — queries on the
+                # other partitions never waited on this one's restage
+                stats["partitions_refreshed"] = len(info["partitions"])
         # reclaim only after the serving view moved over — in-flight
         # buckets on the old view finished during the refresh swap
         stats["purged"] = purge_stale(store, stats)
@@ -323,7 +328,11 @@ class MaintenanceService:
         rb = {"reason": reason[:200], "dirname": next_name,
               "nlist": idx.nlist, "build_seconds": round(build_s, 3)}
         if refresh and self._svc is not None:
-            self._svc.refresh()
+            rinfo = self._svc.refresh()
+            if "partitions" in rinfo:
+                # each partition re-opened its restricted view of the new
+                # index generation in turn (rolling swap, docs/SCALING.md)
+                rb["partitions_refreshed"] = len(rinfo["partitions"])
         if self._svc is not None:
             self._svc._m_rebuilds.inc()
             self._svc.registry.gauge("serve.index_rebuild_pending").set(0.0)

@@ -265,6 +265,35 @@ def merge_topk_host(best_s: np.ndarray, best_i: np.ndarray,
             np.take_along_axis(cat_i, pos, axis=1))
 
 
+def merge_partition_topk(parts) -> Tuple[np.ndarray, np.ndarray]:
+    """Balanced pairwise merge tree over per-partition top-k candidate
+    sets — the host half of the partitioned scatter-gather
+    (infer/partition.py, docs/SCALING.md "Partitioned serving").
+
+    `parts` is a sequence of (scores [Nq, k], page_ids [Nq, k]) — one
+    entry per partition, ids global (-1 = empty slot). Each partition
+    already merged its own shards on device (`sharded_topk` + the
+    per-view merge program); this fold generalizes `merge_shard_topk`'s
+    running merge to partition granularity: pairs merge through
+    `merge_topk_host`, log2(P) levels deep, so the host-side merge cost
+    per level stays O(Nq * k) regardless of partition count. With
+    distinct scores the result is identical to a single global top-k
+    over the union — the byte-identity contract tests/test_partition.py
+    pins against the single-partition exact path."""
+    merged = [(np.asarray(s, np.float32), np.asarray(i, np.int64))
+              for s, i in parts]
+    if not merged:
+        raise ValueError("merge_partition_topk needs at least one partition")
+    while len(merged) > 1:
+        nxt = [merge_topk_host(merged[j][0], merged[j][1],
+                               merged[j + 1][0], merged[j + 1][1])
+               for j in range(0, len(merged) - 1, 2)]
+        if len(merged) % 2:
+            nxt.append(merged[-1])
+        merged = nxt
+    return merged[0]
+
+
 def stage_shard(vecs, rows: int, dim: int, mesh: Mesh, scales=None
                 ) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Zero-pad one store shard to `rows` (the static compiled shape) and

@@ -20,7 +20,7 @@ no branching.
 """
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, List, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -32,6 +32,47 @@ from dnn_page_vectors_tpu.parallel.mesh import fit_mesh_to_devices, make_mesh
 
 def process_info() -> Tuple[int, int]:
     return jax.process_index(), jax.process_count()
+
+
+def partition_shard_ranges(counts: Sequence[int], parts: int
+                           ) -> List[Tuple[int, int]]:
+    """Contiguous [lo, hi) shard-index ranges splitting `counts` (rows per
+    shard, in shard order) into at most `parts` partitions balanced by row
+    count — the ownership map of partitioned serving (infer/partition.py,
+    docs/SCALING.md "Partitioned serving"): partition p owns shards
+    [lo_p, hi_p), its slice of the IVF posting lists, and its cut of the
+    HBM hot set. Contiguity is the point: a partition's id space is an
+    interval, so in a real multi-host deployment each host's shard files,
+    posting files, and append ranges stay disjoint on disk and the
+    existing per-writer append leases give mutual exclusion unchanged.
+
+    Deterministic (pure arithmetic over the shard table): every host —
+    or every host-simulated worker — derives the identical split from the
+    same manifest. `parts` is clamped to the shard count; every returned
+    range is non-empty."""
+    n = len(counts)
+    if n == 0:
+        return [(0, 0)]
+    P = max(1, min(int(parts), n))
+    cum = np.cumsum(np.asarray(counts, np.int64))
+    total = int(cum[-1])
+    cuts: List[int] = []
+    prev = 0
+    for p in range(1, P):
+        target = total * p / P
+        j = int(np.searchsorted(cum, target))
+        # cut on whichever side of the target is closer (ties take the
+        # extra shard): cutting at j puts cum[j-1] rows left of the cut,
+        # at j+1 puts cum[j]
+        if j < n and abs(int(cum[j]) - target) <= \
+                abs((int(cum[j - 1]) if j else 0) - target):
+            j += 1
+        # keep every partition non-empty: at least one shard on each side
+        j = max(prev + 1, min(j, n - (P - p)))
+        cuts.append(j)
+        prev = j
+    bounds = [0] + cuts + [n]
+    return list(zip(bounds[:-1], bounds[1:]))
 
 
 def barrier(name: str) -> None:

@@ -51,7 +51,9 @@ class LockDisciplineRule(Rule):
     family = "locks"
     doc = ("`# guarded-by:` attributes touched outside their lock; thread "
            "targets mutating un-annotated shared state")
-    scope = (f"{PKG_NAME}/infer/serve.py", f"{PKG_NAME}/utils/telemetry.py",
+    scope = (f"{PKG_NAME}/infer/serve.py",
+             f"{PKG_NAME}/infer/partition.py",
+             f"{PKG_NAME}/utils/telemetry.py",
              f"{PKG_NAME}/updates/append.py", f"{PKG_NAME}/maintenance/")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:

@@ -360,6 +360,7 @@ def test_cli_loadtest_json_report_shape_and_seed_determinism(served,
                   "--shape", "poisson", "--p99-ms", "0.5", "--seed", "7",
                   "--distinct", "8", "--trial-s", "0.6", "--warmup-s",
                   "0.2", "--start-qps", "32", "--iters", "1",
+                  "--partitions", "2", "--replicas", "1",
                   "--set", "obs.window_s=0.6",
                   "--set", "serve.batch_window_adaptive=true"]
                  + [x for key, val in _OV.items()
@@ -377,6 +378,14 @@ def test_cli_loadtest_json_report_shape_and_seed_determinism(served,
     assert rep["shape"] == "poisson" and rep["seed"] == 7
     assert rep["p99_target_ms"] == 0.5 and rep["store_vectors"] == 300
     assert rep["batch_window_adaptive"] is True
+    # --partitions P: the report carries the partitioned topology +
+    # per-partition qps/p99/shed block (docs/SCALING.md)
+    assert rep["serve_partitions"] == 2 and rep["serve_replicas"] == 1
+    assert len(rep["partitions"]) == 2
+    for p in rep["partitions"]:
+        for key in ("partition", "qps", "p99_ms", "sheds",
+                    "degraded_serves", "replicas"):
+            assert key in p, key
     assert len(rep["trials"]) >= 2
     for tr in rep["trials"]:
         for key in ("offered_qps", "achieved_qps", "p50_ms", "p99_ms",
