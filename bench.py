@@ -108,9 +108,15 @@ _GATE_SKIP = {"vs_baseline", "attempts", "slo_p99_target_ms",
               "partitioned_store_rows", "partitioned_shards",
               "partitioned_dim", "partitioned_k", "partitioned_iters",
               "partitioned_shed_drill_sheds",
-              "partitioned_shed_drill_degraded_serves"}
+              "partitioned_shed_drill_degraded_serves",
+              # net_serve protocol constants (store geometry, the SLO
+              # target, drill worker counts) — the phase's MEASURED keys
+              # (net_qps_at_p99_p*, net_wire_bytes_per_query,
+              # net_hedge_fire_rate, net_deadline_shed_rate) all gate
+              "net_store_rows", "net_shards", "net_dim", "net_k",
+              "net_p99_target_ms", "net_workers"}
 _LOWER_IS_BETTER = ("_ms", "seconds", "imbalance", "error", "_bytes",
-                    "lint_", "shed")
+                    "lint_", "shed", "hedge")
 
 
 def _lower_is_better(key: str) -> bool:
@@ -1626,6 +1632,272 @@ def run_partitioned_worker() -> None:
     print(json.dumps(rec), flush=True)
 
 
+def run_net_worker() -> None:
+    """The `net_serve` phase (docs/SERVING.md "Network front end"),
+    CPU-honest like the partitioned phase: a synthetic store served by
+    the REAL network stack — asyncio front end over loopback, partition
+    workers as genuine subprocesses behind the WorkerGateway — measured
+    by the loadgen driver's qps@p99 search with the issue path crossing
+    the socket. Records per-topology qps@p99 at P in {1, 2, 4}, wire
+    bytes/query, the hedge drill's fire rate (one deliberately slow
+    replica), and the deadline-shed rate under an over-budget burst."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import shutil
+
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.infer.partition_host import (
+        MeshEmbedder, WorkerGateway)
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    from dnn_page_vectors_tpu.infer.transport import (
+        DeadlineExceeded, SocketSearchClient)
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    from dnn_page_vectors_tpu.loadgen import find_qps_at_p99, make_workload
+
+    dim = int(os.environ.get("BENCH_NET_DIM", "64"))
+    shard_rows = int(os.environ.get("BENCH_NET_SHARD_ROWS", "16384"))
+    n_shards = int(os.environ.get("BENCH_NET_SHARDS", "8"))
+    trial_s = float(os.environ.get("BENCH_NET_TRIAL_S", "1.5"))
+    # the p99 target carries headroom for the 1-core sandbox, where P=4
+    # worker PROCESSES serialize on one core under the front end — the
+    # gate tracks the measured qps, the target is a protocol constant.
+    # start_qps stays >= 16: below that, a short trial's rolling window
+    # sees too few Poisson arrivals for the driver's open-loop sustain
+    # check (achieved >= 0.8x offered) to be statistically meaningful
+    p99_ms = float(os.environ.get("BENCH_NET_P99_MS", "200"))
+    iters = int(os.environ.get("BENCH_NET_ITERS", "2"))
+    start_qps = float(os.environ.get("BENCH_NET_START_QPS", "16"))
+    # best-of-REPS qps@p99 searches per topology: the _best_time
+    # estimator applied to the driver — shared-tenancy noise on this
+    # box can sink ALL of one search's short trials, and best-of keeps
+    # one bad minute from mispricing a topology
+    reps = max(1, int(os.environ.get("BENCH_NET_REPS", "2")))
+    kq = 10
+    rows = shard_rows * n_shards
+    wdir = "/tmp/dnn_page_vectors_tpu_bench/net"
+    sdir = os.path.join(wdir, "store")
+    _stamp(f"net phase: building {rows}-row synthetic store "
+           f"({n_shards} shards, dim {dim})")
+    rng = np.random.default_rng(0)
+    shutil.rmtree(wdir, ignore_errors=True)
+    store = VectorStore(sdir, dim=dim, shard_size=shard_rows)
+    for si in range(n_shards):
+        v = rng.standard_normal((shard_rows, dim)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(si * shard_rows,
+                                        (si + 1) * shard_rows,
+                                        dtype=np.int64), v)
+    store = VectorStore(sdir)
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+    distinct = 32
+    qvs = rng.standard_normal((distinct, dim)).astype(np.float32)
+    qvs /= np.linalg.norm(qvs, axis=1, keepdims=True)
+    qnames = [f"q{i}" for i in range(distinct)]
+    qvec = {name: qvs[i:i + 1] for i, name in enumerate(qnames)}
+
+    class _VecClient:
+        """run_trial-compatible issue shim: query text -> its
+        pre-computed vector over the T_VQUERY wire path."""
+
+        def __init__(self, client):
+            self._client = client
+
+        def search(self, query, k=None, nprobe=None):
+            return self._client.topk_vectors(qvec[query], k=k,
+                                             nprobe=nprobe)
+
+    def _spawn_workers(gw, P, R=1, slow_rids=(), slow_ms=0):
+        procs = []
+        for wp in range(P):
+            for wr in range(R):
+                env = dict(os.environ, JAX_PLATFORMS="cpu")
+                if wr in slow_rids:
+                    env["DPV_WORKER_SLOW_MS"] = str(slow_ms)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "dnn_page_vectors_tpu.cli",
+                     "partition-worker", "--config", "cdssm_toy",
+                     "--workdir", wdir,
+                     "--set", f"model.out_dim={dim}",
+                     "--connect", f"{gw.host}:{gw.port}",
+                     "--partition", str(wp), "--partitions", str(P),
+                     "--replica", str(wr)],
+                    cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+        return procs
+
+    rec = {"net_store_rows": rows, "net_shards": n_shards, "net_dim": dim,
+           "net_k": kq, "net_p99_target_ms": p99_ms}
+    wl = make_workload("poisson", seed=0, distinct=distinct,
+                       profile=((kq, None, 1.0),))
+    wire_per_query = None
+    for P in (1, 2, 4):
+        cfg = get_config("cdssm_toy", {
+            "model.out_dim": dim,
+            # window == trial duration: each trial's p99 reads its OWN
+            # window, not the previous trial's load (the slo-phase
+            # discipline)
+            "obs.window_s": trial_s,
+            "serve.partitions": P, "serve.replicas": 1})
+        svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                            preload_hbm_gb=4.0)
+        gw = WorkerGateway(svc, heartbeat_s=0.5)
+        svc.attach_gateway(gw)
+        procs = _spawn_workers(gw, P)
+        up = gw.wait_for_workers(P, timeout_s=60.0)
+        srv = serve_in_background(svc)
+        client = _VecClient(SocketSearchClient(srv.host, srv.port))
+        try:
+            client.search(qnames[0], k=kq)     # warm every compiled shape
+            req0 = svc._m_requests.value
+            wire0 = svc.wire_bytes
+            _stamp(f"net P={P}: workers_up={up}; searching qps @ "
+                   f"p99<{p99_ms:.0f}ms over loopback (best of {reps})")
+            best, n_trials = 0.0, 0
+            for _ in range(reps):
+                rep = find_qps_at_p99(
+                    svc, wl, qnames, p99_target_ms=p99_ms,
+                    start=start_qps, iters=iters, duration_s=trial_s,
+                    warmup_s=0.5, workers=16, client=client)
+                best = max(best, rep["qps_at_p99"])
+                n_trials += len(rep["trials"])
+            rec[f"net_qps_at_p99_p{P}"] = round(best, 2)
+            reqs = max(svc._m_requests.value - req0, 1)
+            if P == 2:
+                wire_per_query = (svc.wire_bytes - wire0) / reqs
+            _stamp(f"net P={P}: {best:.1f} qps @ "
+                   f"p99<{p99_ms:.0f}ms ({n_trials} trials)")
+        finally:
+            client._client.close()
+            srv.close()
+            for pr in procs:
+                pr.terminate()
+            for pr in procs:
+                try:
+                    pr.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pr.kill()
+            gw.close()
+            svc.close()
+    if wire_per_query is not None:
+        rec["net_wire_bytes_per_query"] = round(wire_per_query, 1)
+
+    # hedge drill: P=1, R=2 over real loopback sockets (thread workers —
+    # their slow_ms is mutable, which the drill needs: the latency
+    # history warms on a HEALTHY primary, then the primary turns slow
+    # and the fan-out must hedge to the fast sibling at the warmed
+    # quantile point)
+    import threading as _threading
+
+    from dnn_page_vectors_tpu.infer.partition_host import PartitionWorker
+    cfg = get_config("cdssm_toy", {
+        "model.out_dim": dim, "serve.partitions": 1, "serve.replicas": 2,
+        "serve.hedge_quantile": 0.9})
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                        preload_hbm_gb=4.0)
+    gw = WorkerGateway(svc, heartbeat_s=0.5)
+    svc.attach_gateway(gw)
+    tworkers = []
+    for wr in range(2):
+        w = PartitionWorker(cfg, sdir, ("127.0.0.1", gw.port), partition=0,
+                            partitions=1, replica=wr, mesh=mesh)
+        _threading.Thread(target=w.run, daemon=True).start()
+        tworkers.append(w)
+    gw.wait_for_workers(2, timeout_s=60.0)
+    try:
+        for i in range(12):                    # warm the latency history
+            svc.topk_vectors(qvs[i % distinct: i % distinct + 1], k=kq)
+        tworkers[0].slow_ms = 40.0             # the primary goes slow
+        h0, n_drill = svc.hedge_fires, 30
+        t0 = time.perf_counter()
+        for i in range(n_drill):
+            svc.topk_vectors(qvs[i % distinct: i % distinct + 1], k=kq)
+        drill_ms = (time.perf_counter() - t0) / n_drill * 1000.0
+        rec["net_hedge_fire_rate"] = round(
+            (svc.hedge_fires - h0) / n_drill, 4)
+        rec["net_hedged_latency_ms"] = round(drill_ms, 3)
+        _stamp(f"net hedge drill: fire rate "
+               f"{rec['net_hedge_fire_rate']:.2f}, "
+               f"{drill_ms:.1f} ms/query against a 40 ms-slow primary")
+    finally:
+        for w in tworkers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+    # deadline-shed drill: a burst of requests whose budget is smaller
+    # than the socket->executor hop itself — admission finds them
+    # EXPIRED at the door and sheds (T_SHED), never errors
+    cfg = get_config("cdssm_toy", {"model.out_dim": dim})
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                        preload_hbm_gb=4.0)
+    srv = serve_in_background(svc)
+    vclient = SocketSearchClient(srv.host, srv.port)
+    try:
+        vclient.topk_vectors(qvs[:1], k=kq)    # warm: compile off-drill
+        sheds0 = svc.deadline_sheds
+        errors = 0
+        n_burst, shed_seen = 200, 0
+        for i in range(n_burst):
+            try:
+                vclient.topk_vectors(qvs[i % distinct: i % distinct + 1],
+                                     k=kq, deadline_ms=0.05)
+            except DeadlineExceeded:
+                shed_seen += 1
+            except Exception:  # noqa: BLE001 — drill metric, not fatal
+                errors += 1
+        rec["net_deadline_shed_rate"] = round(
+            max(svc.deadline_sheds - sheds0, shed_seen) / n_burst, 4)
+        rec["net_deadline_drill_errors"] = errors
+        _stamp(f"net deadline drill: shed rate "
+               f"{rec['net_deadline_shed_rate']:.2f} at a 0.05 ms budget "
+               f"({errors} errors)")
+    finally:
+        vclient.close()
+        srv.close()
+        svc.close()
+    print(json.dumps(rec), flush=True)
+
+
+def _run_net() -> dict:
+    """Run the net_serve phase in a CPU subprocess and return its keys —
+    merged into every record (null-honest device phases included), so
+    this sandbox produces real over-the-wire numbers with no TPU."""
+    if os.environ.get("BENCH_NET", "1") == "0":
+        return {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--net-worker"],
+            capture_output=True, text=True,
+            timeout=int(os.environ.get("BENCH_NET_TIMEOUT_S", "900")),
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "net_store_rows" in rec:
+                return rec
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"net_error":
+                (" | ".join(tail[-3:]) if tail
+                 else f"rc={proc.returncode}")[:300]}
+    except subprocess.TimeoutExpired:
+        return {"net_error": "net worker timed out"}
+    except Exception as e:  # noqa: BLE001 — the phase never costs a round
+        return {"net_error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def _run_partitioned() -> dict:
     """Run the host-simulated partitioned phase in a CPU subprocess and
     return its keys (merged into whatever record the wrapper prints —
@@ -1741,6 +2013,7 @@ def main() -> None:
         "error": last_err[-500:], "attempts": attempt,
     }
     rec.update(_run_partitioned())
+    rec.update(_run_net())
     print(json.dumps(rec))
 
 
@@ -1749,6 +2022,7 @@ def _finalize(rec: dict) -> None:
     re-run the regression gate over the full key set, and print the final
     record (the one the driver parses)."""
     rec.update(_run_partitioned())
+    rec.update(_run_net())
     prev = _previous_bench_record()
     _, regs = _regression_gate(rec, prev)
     rec["regressions"] = regs
@@ -1761,5 +2035,7 @@ if __name__ == "__main__":
         run_worker()
     elif "--partitioned-worker" in sys.argv:
         run_partitioned_worker()
+    elif "--net-worker" in sys.argv:
+        run_net_worker()
     else:
         main()

@@ -19,6 +19,10 @@
   python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy --watch 2
   python -m dnn_page_vectors_tpu.cli loadtest --config cdssm_toy \
       --shape poisson --p99-ms 50 --seed 0
+  python -m dnn_page_vectors_tpu.cli loadtest --config cdssm_toy \
+      --transport socket --partitions 2
+  python -m dnn_page_vectors_tpu.cli partition-worker --config cdssm_toy \
+      --connect 127.0.0.1:9410 --partition 0 --partitions 2
   python -m dnn_page_vectors_tpu.cli lint
   python -m dnn_page_vectors_tpu.cli lint --write-baseline
 
@@ -117,7 +121,7 @@ def main(argv=None) -> None:
                                         "reset-store", "index", "append",
                                         "refresh", "maintain", "trace",
                                         "serve-metrics", "loadtest",
-                                        "lint"])
+                                        "partition-worker", "lint"])
     ap.add_argument("--once", action="store_true",
                     help="maintain: run ONE synchronous pass of every "
                          "pillar (janitor, compaction, rebuild) and exit "
@@ -218,6 +222,26 @@ def main(argv=None) -> None:
                     help="loadtest/search: serve.replicas override — R "
                          "health-routed copies of every partition "
                          "(shorthand for --set serve.replicas=R)")
+    ap.add_argument("--transport", default="inproc",
+                    choices=["inproc", "socket"],
+                    help="loadtest: 'socket' runs the asyncio front end "
+                         "(infer/server.py) over loopback — with "
+                         "partitions > 1 it also spawns one "
+                         "`partition-worker` SUBPROCESS per replica — and "
+                         "points the driver's issue path at the socket "
+                         "client, so qps@p99 covers the full network path "
+                         "(docs/SERVING.md 'Network front end')")
+    # -- partition-worker (docs/SERVING.md "Network front end") ------------
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="partition-worker: the front end's WorkerGateway "
+                         "address to register with")
+    ap.add_argument("--partition", type=int, default=0, metavar="I",
+                    help="partition-worker: which partition of the "
+                         "--partitions-way balanced split this process "
+                         "serves")
+    ap.add_argument("--replica", type=int, default=0, metavar="R",
+                    help="partition-worker: this process's replica id "
+                         "within its partition")
     ap.add_argument("--mutate-every", dest="mutate_every", type=float,
                     default=None, metavar="S",
                     help="loadtest: hot-swap refresh() every S seconds of "
@@ -451,6 +475,24 @@ def main(argv=None) -> None:
                             sort_keys=True), flush=True)
         except KeyboardInterrupt:
             ms.close()
+        return
+
+    if args.command == "partition-worker":
+        # One partition replica as a real process (docs/SERVING.md
+        # "Network front end"): opens the store, builds its restricted
+        # view over the --partitions-way balanced split, registers with
+        # the front end's WorkerGateway at --connect, heartbeats, and
+        # answers vector RPCs over its slice until the gateway hangs up.
+        # Needs NO model or checkpoint — just the store and a device mesh
+        # for staging + the compiled top-k.
+        if not args.connect:
+            ap.error("partition-worker requires --connect HOST:PORT")
+        from dnn_page_vectors_tpu.infer.partition_host import (
+            run_partition_worker)
+        partitions = max(1, args.partitions or 1)
+        run_partition_worker(cfg, store_dir, args.connect,
+                             partition=args.partition,
+                             partitions=partitions, replica=args.replica)
         return
 
     if args.command == "init-store":
@@ -728,6 +770,54 @@ def main(argv=None) -> None:
         k = args.topk or cfg.eval.recall_k
         svc.warmup(k=k)
         svc.start_batcher()
+        client = None
+        net_server = gateway = None
+        worker_procs = []
+        if args.transport == "socket":
+            # the over-the-wire path (docs/SERVING.md "Network front
+            # end"): asyncio front end over loopback; with partitions a
+            # WorkerGateway + one partition-worker SUBPROCESS per
+            # replica, so the measured qps@p99 crosses real process
+            # boundaries and the RPC fan-out (hedging, liveness routing)
+            import subprocess
+            import sys as _sys
+
+            from dnn_page_vectors_tpu.infer.partition_host import (
+                WorkerGateway)
+            from dnn_page_vectors_tpu.infer.server import (
+                serve_in_background)
+            from dnn_page_vectors_tpu.infer.transport import (
+                SocketSearchClient)
+            if svc.partition_set is not None:
+                gateway = WorkerGateway(svc)
+                svc.attach_gateway(gateway)
+                P = svc.partition_set.partitions
+                R = svc.partition_set.replicas
+                base_cmd = [_sys.executable, "-m",
+                            "dnn_page_vectors_tpu.cli", "partition-worker",
+                            "--config", args.config,
+                            "--workdir", cfg.workdir,
+                            "--connect", f"{gateway.host}:{gateway.port}",
+                            "--partitions", str(P)]
+                for pair in args.overrides or []:
+                    base_cmd += ["--set", pair]
+                for wp in range(P):
+                    for wr in range(R):
+                        worker_procs.append(subprocess.Popen(
+                            base_cmd + ["--partition", str(wp),
+                                        "--replica", str(wr)],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL))
+                if not gateway.wait_for_workers(P * R, timeout_s=120.0):
+                    print(json.dumps({
+                        "warning": "not every partition worker registered"
+                                   " in time; unserved partitions fall "
+                                   "back to local views",
+                        "workers_live": len(gateway.live_workers()),
+                        "expected": P * R}), file=sys.stderr, flush=True)
+            net_server = serve_in_background(svc)
+            client = SocketSearchClient(net_server.host, net_server.port,
+                                        deadline_ms=cfg.serve.deadline_ms)
         distinct = max(1, args.distinct)
         queries = [trainer.corpus.query_text(i) for i in range(distinct)]
         wl = make_workload(args.shape, seed=args.seed, distinct=distinct,
@@ -767,9 +857,17 @@ def main(argv=None) -> None:
         report = find_qps_at_p99(
             svc, wl, queries, p99_target_ms=args.p99_ms,
             start=args.start_qps, iters=args.iters, duration_s=trial_s,
-            warmup_s=args.warmup_s, mutator=mut,
+            warmup_s=args.warmup_s, mutator=mut, client=client,
             progress=lambda line: print(line, file=sys.stderr, flush=True),
             progress_every_s=max(1.0, trial_s / 2.0))
+        if args.transport == "socket":
+            final_met = svc.metrics()
+            report.update({
+                "transport": "socket",
+                "listen": f"{net_server.host}:{net_server.port}",
+                **({"transport_totals": final_met["transport"]}
+                   if "transport" in final_met else {}),
+            })
         if maint is not None:
             final_met = svc.metrics()
             report.update({
@@ -791,6 +889,19 @@ def main(argv=None) -> None:
                 "partition_degraded": part_met["partition_degraded"],
                 "partitions": part_met["partitions"],
             })
+        if client is not None:
+            client.close()
+        if net_server is not None:
+            net_server.close()
+        for proc in worker_procs:
+            proc.terminate()
+        for proc in worker_procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — a stuck worker gets killed
+                proc.kill()
+        if gateway is not None:
+            gateway.close()
         svc.close()
         report.update({
             "store_vectors": store.num_vectors,

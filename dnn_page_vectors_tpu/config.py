@@ -269,6 +269,29 @@ class ServeConfig:
     # sheds to its siblings. Only a routing preference — with every
     # replica over budget the least-loaded healthy one still serves.
     replica_shed_queue: int = 8
+    # -- over-the-wire serving (infer/transport.py, infer/server.py,
+    # infer/partition_host.py; docs/SERVING.md "Network front end") ------
+    # Listen address of the asyncio socket front end ("host:port"; port 0
+    # binds an ephemeral port, reported by the server handle/CLI).
+    listen: str = "127.0.0.1:0"
+    # Default per-request deadline budget (ms) applied at admission when
+    # a request carries none. A request that cannot make its deadline is
+    # shed AT THE DOOR (serve.deadline_shed + deadline_shed event) —
+    # before it can consume a micro-batch bucket slot — and one whose
+    # deadline expires while queued is shed at dispatch. 0 disables.
+    deadline_ms: float = 0.0
+    # Hedged fan-out (partition RPC): when a partition's answer has not
+    # arrived within this quantile of its observed RPC latency, the same
+    # request fires at a sibling replica's worker and the first answer
+    # wins (serve.hedge_fired + hedge_fired event). Needs >= 8 latency
+    # samples before it ever fires; <= 0 (or >= 1) disables hedging.
+    hedge_quantile: float = 0.95
+    # Partition-worker heartbeat interval (seconds). A worker whose last
+    # heartbeat is older than 2x this — or whose registration connection
+    # dropped — is LOST (worker_lost event): routing sheds its replica
+    # (reason "liveness") and the fan-out serves its slice from the
+    # front end's local view until it re-registers.
+    heartbeat_s: float = 0.5
 
 
 @dataclasses.dataclass(frozen=True)

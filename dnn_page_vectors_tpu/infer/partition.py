@@ -256,6 +256,12 @@ class PartitionSet:
         self._sheds = [0] * self.partitions        # guarded-by: _route_lock
         self._degraded_serves = [0] * self.partitions  # guarded-by: _route_lock
         self._last_health: Dict[int, tuple] = {}   # guarded-by: _route_lock
+        # liveness oracle (docs/SERVING.md "Network front end"): when a
+        # WorkerGateway is attached, (pid, rid) -> is that replica's
+        # partition worker alive (registered + heartbeating)? None = the
+        # in-process default, every replica counts as live. Swapped by
+        # one reference assignment (set_liveness), snapshot-read per call.
+        self._liveness = None
         # creation timestamp: written once here, read-only afterwards
         self._t0 = time.perf_counter()
 
@@ -268,24 +274,49 @@ class PartitionSet:
         return [reps[0].spec for reps in self._parts]
 
     # -- routing -----------------------------------------------------------
+    def set_liveness(self, fn) -> None:
+        """Install (or clear, with None) the worker-liveness oracle:
+        `fn(pid, rid) -> bool`. With a gateway attached, routing health
+        derives from worker liveness (registration + heartbeats) on top
+        of the in-process flags (docs/SERVING.md "Network front end")."""
+        self._liveness = fn
+
+    def _alive(self, pid: int, rid: int) -> bool:
+        fn = self._liveness
+        return True if fn is None else bool(fn(pid, rid))
+
     def _route(self, pid: int) -> _PartitionReplica:
         """Pick the replica that answers partition `pid`'s next request.
-        Preference order: healthy (serving its HBM view, not restaging,
-        under the queue budget) > over-budget-but-healthy > degraded.
-        Leaving the primary is a shed (counted; `replica_shed` event on
-        transitions); serving on a degraded replica because every sibling
-        is degraded too is a `partition_degraded` — the never-empty
-        fallback the availability contract demands."""
+        Preference order: live + healthy (worker heartbeating, serving
+        its HBM view, not restaging, under the queue budget) >
+        live-but-over-budget > healthy-with-a-dead-worker (serves its
+        LOCAL view — the gateway's fallback) > degraded. Leaving the
+        primary is a shed (counted; `replica_shed` event on transitions,
+        reason restaging/degraded/liveness/queue); serving on a degraded
+        replica because every sibling is degraded too is a
+        `partition_degraded` — the never-empty fallback the availability
+        contract demands."""
         reps = self._parts[pid]
         primary = reps[0]
         chosen = None
         degraded_serve = False
         for r in reps:
             if (not r.restaging and not r.degraded
+                    and self._alive(pid, r.rid)
                     and r.queue_depth <= self._shed_queue):
                 chosen = r
                 break
         if chosen is None:
+            for r in reps:
+                if (not r.restaging and not r.degraded
+                        and self._alive(pid, r.rid)):
+                    chosen = r
+                    break
+        if chosen is None:
+            # no replica has a LIVE worker: a healthy replica still
+            # serves from its local view (the gateway falls back to
+            # in-process compute) — healthy local serving is NOT a
+            # degraded serve
             for r in reps:
                 if not r.restaging and not r.degraded:
                     chosen = r
@@ -306,7 +337,9 @@ class PartitionSet:
         reason = None
         if shed:
             reason = ("restaging" if primary.restaging
-                      else "degraded" if primary.degraded else "queue")
+                      else "degraded" if primary.degraded
+                      else "liveness" if not self._alive(pid, primary.rid)
+                      else "queue")
             svc._m_replica_shed.inc()
         if degraded_serve:
             svc._m_partition_degraded.inc()

@@ -1,0 +1,704 @@
+"""Multi-process partition serving: workers, registration, hedged fan-out
+(docs/SERVING.md "Network front end", docs/SCALING.md "Partitioned
+serving").
+
+PR 12 made partitions an abstraction (`infer/partition.py`): P x R
+host-simulated worker THREADS, each owning a `_ServeView` over its
+`PartitionSpec` slice. This module puts each replica behind a real
+process and socket boundary:
+
+  * `PartitionWorker` — one partition replica as its own process (or, in
+    tests, a thread with its own service instance): opens the store,
+    builds ONE restricted view over its spec's contiguous shard range
+    (the same `SearchService._build_view` the in-process replicas use, so
+    results are byte-identical by construction), connects to the front
+    end's `WorkerGateway`, REGISTERs, heartbeats, and answers `T_VQUERY`
+    frames with `_topk_view` over its slice. `cli partition-worker` is
+    the process entry point.
+  * `WorkerGateway` — the front-end side: a plain-socket listener where
+    workers register, one reader thread per worker demultiplexing
+    responses by request id, and the scatter itself — `topk()` fans the
+    coalesced query block out to one routed worker per partition (routing
+    still goes through `PartitionSet._route`, which now sees worker
+    LIVENESS: a dead worker's replica sheds with reason "liveness"
+    exactly like a restaging one sheds in-process).
+
+Tail-latency control:
+
+  * **per-partition deadlines** — the fan-out budgets each RPC against
+    the coalesced batch's tightest deadline (relative remaining ms on the
+    wire; the worker re-anchors on its own clock).
+  * **hedged requests** — when a partition's answer has not arrived
+    within the `serve.hedge_quantile` quantile of that partition's
+    observed RPC latency, the SAME request fires at a sibling replica's
+    worker and the first answer wins (`serve.hedge_fired` counter,
+    `hedge_fired` event). Hedging needs a latency history (>= 8 samples)
+    — a cold gateway never hedges on guesses.
+  * **local fallback** — a worker that is dead, times out, or tears its
+    response degrades EXACTLY like the in-process shed path: the gateway
+    computes that partition's slice on the front end's own view
+    (`_topk_view` over the identical shard range), so a kill -9 or a
+    truncated frame can change latency but never bytes — the result-set
+    identity pin extends over the wire.
+
+Liveness: a worker is alive while its registration connection is open
+and its last heartbeat is younger than 2 x `serve.heartbeat_s`.
+Connection EOF / torn frames mark it lost immediately (`worker_lost`
+event) and fail its in-flight RPCs over to the fallback path — recovery
+is bounded by one heartbeat interval even for a silently hung peer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, Future
+from concurrent.futures import wait as futures_wait
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dnn_page_vectors_tpu.infer import transport
+from dnn_page_vectors_tpu.infer.transport import (
+    DeadlineExceeded, FrameError, RemoteError, T_BYE, T_HEARTBEAT,
+    T_REGISTER, T_RESULT, T_SHED, T_ERROR, T_VQUERY)
+from dnn_page_vectors_tpu.ops.topk import merge_partition_topk
+from dnn_page_vectors_tpu.utils.profiling import LatencyStats
+
+
+class MeshEmbedder:
+    """The model-free embedder stub a partition worker serves with: the
+    serving top-k only needs the device mesh (staging + compiled top-k);
+    tokenize/encode never run on the vector RPC path."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self.query_tok = None
+        self.page_tok = None
+
+
+class _WorkerConn:
+    """Front-end-side record of one registered partition worker."""
+
+    def __init__(self, sock: socket.socket, addr, partition: int,
+                 replica: int, pid: int):
+        self.sock = sock
+        self.addr = addr
+        self.partition = int(partition)
+        self.replica = int(replica)
+        self.pid = int(pid)
+        self.wlock = threading.Lock()      # serializes frame writes
+        self._lock = threading.Lock()
+        self._last_beat = time.perf_counter()   # guarded-by: _lock
+        self._dead = False                       # guarded-by: _lock
+        self._lost_reason: Optional[str] = None  # guarded-by: _lock
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = time.perf_counter()
+
+    def alive(self, max_age_s: float) -> bool:
+        with self._lock:
+            if self._dead:
+                return False
+            return (time.perf_counter() - self._last_beat) <= max_age_s
+
+    def mark_dead(self, reason: str) -> bool:
+        """-> True exactly once (the caller that transitions it emits the
+        worker_lost event)."""
+        with self._lock:
+            if self._dead:
+                return False
+            self._dead = True
+            self._lost_reason = reason
+            return True
+
+    @property
+    def dead(self) -> bool:
+        with self._lock:
+            return self._dead
+
+
+class WorkerGateway:
+    """The front end's worker registry + RPC fan-out (one per service).
+
+    Workers connect to `port` and REGISTER; the gateway reads heartbeats
+    and responses off each connection on a dedicated reader thread and
+    exposes `topk()` — the over-the-wire scatter `SearchService` routes
+    through when attached (`svc.attach_gateway(gw)`)."""
+
+    def __init__(self, svc, pset=None, host: str = "127.0.0.1",
+                 port: int = 0, heartbeat_s: Optional[float] = None,
+                 hedge_quantile: Optional[float] = None,
+                 rpc_timeout_s: float = 10.0):
+        self._svc = svc
+        serve_cfg = getattr(svc.cfg, "serve", None)
+        self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
+                            else getattr(serve_cfg, "heartbeat_s", 0.5)
+                            if serve_cfg is not None else 0.5)
+        self.hedge_quantile = (
+            hedge_quantile if hedge_quantile is not None
+            else getattr(serve_cfg, "hedge_quantile", 0.95)
+            if serve_cfg is not None else 0.95)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self._own_pset = None
+        if pset is None:
+            pset = svc.partition_set
+        if pset is None:
+            # single-view service: fan out through a 1-partition set the
+            # gateway owns (routing/health state lives there) — the P=1
+            # over-the-wire topology is a worker, not a special case
+            from dnn_page_vectors_tpu.infer.partition import PartitionSet
+            self._own_pset = pset = PartitionSet(svc, svc.store,
+                                                 partitions=1, replicas=1)
+        self.partition_set = pset
+        self._lock = threading.Lock()
+        self._workers: Dict[Tuple[int, int], _WorkerConn] = {}  # guarded-by: _lock
+        self._pending: Dict[int, Tuple[Future, _WorkerConn]] = {}  # guarded-by: _lock
+        self._lat: Dict[int, LatencyStats] = {}   # guarded-by: _lock
+        self._registered = 0                      # guarded-by: _lock
+        self._rpcs = 0                            # guarded-by: _lock
+        self._rpc_fallbacks = 0                   # guarded-by: _lock
+        self._closed = False                      # guarded-by: _lock
+        self._threads: List[threading.Thread] = []   # guarded-by: _lock
+        # the listener socket and the accept-thread handle are OWNER
+        # state: bound here, closed/joined only by close() — reader
+        # threads never touch them
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._accept_t = threading.Thread(target=self._accept_loop,
+                                          daemon=True,
+                                          name="worker-gateway-accept")
+        self._accept_t.start()
+
+    # -- registry ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return            # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._conn_loop, args=(conn, addr),
+                                 daemon=True, name="worker-gateway-reader")
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._threads.append(t)
+            t.start()
+
+    def _conn_loop(self, conn: socket.socket, addr) -> None:
+        """One registered worker's reader: REGISTER first, then
+        heartbeats and RPC responses until EOF/torn frame."""
+        svc = self._svc
+        worker: Optional[_WorkerConn] = None
+        reason = "connection closed"
+        try:
+            frame = transport.read_frame(conn)
+            if frame is None or frame[0] != T_REGISTER:
+                conn.close()
+                return
+            svc._m_wire_bytes.inc(transport.HEADER.size + len(frame[1]))
+            pid_, rid, wpid = transport.decode_register(frame[1])
+            worker = _WorkerConn(conn, addr, pid_, rid, wpid)
+            with self._lock:
+                old = self._workers.get((pid_, rid))
+                self._workers[(pid_, rid)] = worker
+                self._registered += 1
+            if old is not None and old.mark_dead("replaced"):
+                self._fail_inflight(old, "replaced by a new registration")
+            svc.registry.event("worker_registered", {
+                "partition": pid_, "replica": rid, "pid": wpid,
+                "addr": f"{addr[0]}:{addr[1]}"})
+            while True:
+                frame = transport.read_frame(conn)
+                if frame is None:
+                    break
+                ftype, payload = frame
+                svc._m_wire_bytes.inc(transport.HEADER.size + len(payload))
+                if ftype == T_HEARTBEAT:
+                    worker.beat()
+                elif ftype in (T_RESULT, T_SHED, T_ERROR):
+                    worker.beat()     # any traffic proves liveness
+                    self._resolve(ftype, payload)
+                elif ftype == T_BYE:
+                    reason = "deregistered"
+                    break
+                else:
+                    reason = f"unexpected frame type {ftype}"
+                    break
+        except FrameError as e:
+            # torn response / garbage: indistinguishable from a crashed
+            # peer — treated exactly like one
+            reason = f"torn frame: {e}"
+        except OSError as e:
+            reason = f"socket error: {e}"
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if worker is not None and worker.mark_dead(reason):
+                self._fail_inflight(worker, reason)
+                svc.registry.event("worker_lost", {
+                    "partition": worker.partition,
+                    "replica": worker.replica,
+                    "reason": reason[:200]})
+
+    def _resolve(self, ftype: int, payload: bytes) -> None:
+        if ftype == T_RESULT:
+            req_id, scores, ids, scan = transport.decode_result(payload)
+            ok: Optional[Tuple] = (scores, ids, scan)
+            exc: Optional[Exception] = None
+        elif ftype == T_SHED:
+            req_id, code, why = transport.decode_shed(payload)
+            ok, exc = None, DeadlineExceeded(why or f"shed code {code}")
+        else:
+            req_id, msg = transport.decode_error(payload)
+            ok, exc = None, RemoteError(msg)
+        with self._lock:
+            entry = self._pending.pop(req_id, None)
+        if entry is None:
+            return                # a hedged loser landing late: discard
+        fut, _ = entry
+        if exc is None:
+            fut.set_result(ok)
+        else:
+            fut.set_exception(exc)
+
+    def _fail_inflight(self, worker: _WorkerConn, reason: str) -> None:
+        with self._lock:
+            doomed = [rid for rid, (_, w) in self._pending.items()
+                      if w is worker]
+            entries = [self._pending.pop(rid) for rid in doomed]
+        for fut, _ in entries:
+            fut.set_exception(RemoteError(f"worker lost: {reason}"))
+
+    # -- liveness (PartitionSet routing + availability tests) --------------
+    def _alive_age_s(self) -> float:
+        """Max heartbeat age before a CONNECTED worker counts as hung:
+        two missed beats, plus a floor for host scheduling jitter (a
+        loaded 1-core box can delay an idle worker's heartbeat thread
+        past a bare 2x multiple). Crashes never wait for this — a dead
+        connection reads EOF and marks the worker lost immediately."""
+        return 2.0 * self.heartbeat_s + 0.25
+
+    def worker_alive(self, pid: int, rid: int) -> bool:
+        with self._lock:
+            w = self._workers.get((pid, rid))
+        return w is not None and w.alive(self._alive_age_s())
+
+    def active(self) -> bool:
+        """Any live worker at all? False = the in-process scatter serves
+        (zero per-request overhead when no fleet is attached)."""
+        with self._lock:
+            workers = list(self._workers.values())
+        age = self._alive_age_s()
+        return any(w.alive(age) for w in workers)
+
+    def live_workers(self) -> List[Tuple[int, int]]:
+        with self._lock:
+            keys = list(self._workers)
+        return [key for key in keys if self.worker_alive(*key)]
+
+    def wait_for_workers(self, n: int, timeout_s: float = 30.0) -> bool:
+        """Block until `n` workers are live (fleet-start barrier for
+        cli/bench) — False on timeout."""
+        t_end = time.perf_counter() + timeout_s
+        while time.perf_counter() < t_end:
+            if len(self.live_workers()) >= n:
+                return True
+            time.sleep(0.01)
+        return len(self.live_workers()) >= n
+
+    def _pick_worker(self, pid: int, prefer_rid: int,
+                     exclude: Tuple[int, ...] = ()) -> Optional[_WorkerConn]:
+        """The live worker that should answer partition `pid`: the routed
+        replica's own worker when live, else the lowest-rid live sibling
+        not in `exclude`."""
+        with self._lock:
+            cands = [(rid, w) for (p, rid), w in self._workers.items()
+                     if p == pid and rid not in exclude]
+        cands.sort(key=lambda t: (t[0] != prefer_rid, t[0]))
+        age = self._alive_age_s()
+        for _, w in cands:
+            if w.alive(age):
+                return w
+        return None
+
+    # -- the RPC fan-out ---------------------------------------------------
+    def _send(self, worker: _WorkerConn, qv: np.ndarray, n: int, k: int,
+              nprobe: Optional[int],
+              deadline: Optional[float]) -> Future:
+        svc = self._svc
+        req_id = transport.next_request_id()
+        rem_ms = 0.0
+        if deadline is not None:
+            rem_ms = max((deadline - svc._clock()) * 1000.0, 0.001)
+        payload = transport.encode_vquery(req_id, qv[:n], k=k,
+                                          nprobe=nprobe or 0,
+                                          deadline_ms=rem_ms)
+        fut: Future = Future()
+        with self._lock:
+            self._pending[req_id] = (fut, worker)
+            self._rpcs += 1
+        try:
+            with worker.wlock:
+                transport.write_frame(worker.sock, T_VQUERY, payload,
+                                      counter=svc._m_wire_bytes)
+        except OSError as e:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            if worker.mark_dead(f"send failed: {e}"):
+                self._fail_inflight(worker, f"send failed: {e}")
+                svc.registry.event("worker_lost", {
+                    "partition": worker.partition,
+                    "replica": worker.replica,
+                    "reason": f"send failed: {e}"[:200]})
+            fut.set_exception(RemoteError(f"send failed: {e}"))
+        return fut
+
+    def _hedge_delay_s(self, pid: int) -> Optional[float]:
+        """The wait before hedging partition `pid`: the hedge-quantile
+        point of its observed RPC latency, or None while the history is
+        too thin (< 8 samples) to hedge on evidence."""
+        q = self.hedge_quantile
+        if not 0.0 < q < 1.0:
+            return None
+        with self._lock:
+            lat = self._lat.get(pid)
+            if lat is None or len(lat) < 8:
+                return None
+            return max(lat.percentile_ms(q * 100.0) / 1000.0, 1e-4)
+
+    def _record_latency(self, pid: int, seconds: float) -> None:
+        with self._lock:
+            lat = self._lat.get(pid)
+            if lat is None:
+                lat = self._lat[pid] = LatencyStats()
+            lat.add(seconds)
+
+    def _await_partition(self, pid: int, prefer_rid: int, first: Future,
+                         first_rid: int, qv: np.ndarray, n: int, k: int,
+                         nprobe: Optional[int],
+                         deadline: Optional[float]) -> Optional[Tuple]:
+        """Wait for partition `pid`'s RPC answer, hedging to a sibling at
+        the latency-quantile point and failing over on worker loss; None
+        when every wire route failed (the caller serves locally)."""
+        svc = self._svc
+        t0 = time.perf_counter()
+        budget = self.rpc_timeout_s
+        if deadline is not None:
+            rem = deadline - svc._clock()
+            budget = min(budget, max(rem, 0.0))
+        in_flight: Dict[Future, int] = {first: first_rid}
+        tried = {first_rid}
+        hedged = False
+        while True:
+            elapsed = time.perf_counter() - t0
+            remaining = budget - elapsed
+            hedge_s = None if hedged else self._hedge_delay_s(pid)
+            if hedge_s is not None and elapsed < hedge_s:
+                timeout = min(hedge_s - elapsed, max(remaining, 0.0))
+            else:
+                timeout = max(remaining, 0.0)
+            done, _ = futures_wait(set(in_flight), timeout=timeout,
+                                   return_when=FIRST_COMPLETED)
+            for fut in done:
+                rid = in_flight.pop(fut)
+                if fut.exception() is None:
+                    if not hedged:
+                        # only UNHEDGED completions feed the hedge-delay
+                        # history: a hedged call finishes slow by
+                        # definition (the hedge only fired because it
+                        # crossed the quantile), and recording it would
+                        # drag the threshold up until hedging turned
+                        # itself off — the healthy-path distribution is
+                        # the reference the quantile must track
+                        self._record_latency(pid,
+                                             time.perf_counter() - t0)
+                    return fut.result()
+                tried.add(rid)
+            elapsed = time.perf_counter() - t0
+            if elapsed >= budget and not in_flight:
+                return None
+            if not in_flight:
+                # every issued RPC failed: fail over to an untried live
+                # sibling (not a hedge — the first copy is already dead)
+                w = self._pick_worker(pid, prefer_rid,
+                                      exclude=tuple(tried))
+                if w is None:
+                    return None
+                in_flight[self._send(w, qv, n, k, nprobe, deadline)] = \
+                    w.replica
+                tried.add(w.replica)
+                continue
+            if elapsed >= budget:
+                return None
+            if (not hedged and hedge_s is not None
+                    and elapsed >= hedge_s):
+                hedged = True
+                w = self._pick_worker(pid, prefer_rid,
+                                      exclude=tuple(tried))
+                if w is not None:
+                    svc._m_hedge_fired.inc()
+                    cur = svc.tracer.current()
+                    svc.registry.event("hedge_fired", {
+                        "partition": pid, "from_replica": first_rid,
+                        "to_replica": w.replica,
+                        "after_ms": round(elapsed * 1000.0, 3),
+                    }, trace_id=getattr(cur, "trace_id", None))
+                    in_flight[self._send(w, qv, n, k, nprobe,
+                                         deadline)] = w.replica
+                    tried.add(w.replica)
+
+    # graftcheck: hot
+    def topk(self, qv: np.ndarray, n: int, k: int,
+             nprobe: Optional[int] = None,
+             deadline: Optional[float] = None
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """The over-the-wire scatter-gather: one routed worker RPC per
+        partition (hedged, deadline-budgeted), per-partition LOCAL
+        fallback on any wire failure, winners folded through the same
+        partition merge tree as the in-process scatter — results
+        byte-identical to `PartitionSet.topk` by construction."""
+        svc = self._svc
+        pset = self.partition_set
+        table = pset._view_table
+        P = pset.partitions
+        calls: List[Tuple[int, object, Optional[Future], int]] = []
+        with svc._stage("scatter", partitions=P, transport="socket"):
+            for pid in range(P):
+                rep = pset._route(pid)
+                w = self._pick_worker(pid, rep.rid)
+                if w is None:
+                    calls.append((pid, rep, None, -1))
+                else:
+                    calls.append((pid, rep,
+                                  self._send(w, qv, n, k, nprobe, deadline),
+                                  w.replica))
+            parts: List[Optional[Tuple]] = [None] * P
+            for pid, rep, fut, rid in calls:
+                res = None
+                if fut is not None:
+                    with svc._stage("rpc", partition=pid, replica=rid):
+                        res = self._await_partition(
+                            pid, rep.rid, fut, rid, qv, n, k, nprobe,
+                            deadline)
+                if res is None:
+                    # the in-process degrade path, verbatim: this
+                    # partition's slice computed on the front end's own
+                    # view — a dead/torn/late worker costs latency,
+                    # never bytes
+                    if fut is not None:
+                        with self._lock:
+                            self._rpc_fallbacks += 1
+                    view = table[pid][rep.rid]
+                    res = svc._topk_view(view, qv, n, k, nprobe)
+                parts[pid] = res
+        with svc._stage("merge"):
+            return merge_partition_topk([(s, i) for s, i, _ in parts])
+
+    # -- telemetry / lifecycle --------------------------------------------
+    def stats(self) -> Dict:
+        """The metrics()/loadtest transport sub-block."""
+        with self._lock:
+            registered = self._registered
+            rpcs = self._rpcs
+            fallbacks = self._rpc_fallbacks
+        return {
+            "workers_live": len(self.live_workers()),
+            "workers_registered": registered,
+            "rpcs": rpcs,
+            "rpc_fallbacks": fallbacks,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            workers = list(self._workers.values())
+            threads = list(self._threads)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for w in workers:
+            w.mark_dead("gateway closed")
+            try:
+                w.sock.close()
+            except OSError:
+                pass
+        self._accept_t.join(timeout=5.0)
+        for t in threads:
+            t.join(timeout=5.0)
+        if self._own_pset is not None:
+            self._own_pset.close()
+
+
+# ---------------------------------------------------------------------------
+# the worker side
+# ---------------------------------------------------------------------------
+
+class PartitionWorker:
+    """One partition replica serving its `PartitionSpec` slice over a
+    socket. As a process: `cli partition-worker` (the production shape);
+    in tests it also runs as a thread with its own service instance —
+    either way it owns an independent restricted view built by the exact
+    `_build_view` the in-process replicas use."""
+
+    def __init__(self, cfg, store_dir: str, connect: Tuple[str, int],
+                 partition: int, partitions: int, replica: int = 0,
+                 mesh=None, preload_hbm_gb: float = 4.0,
+                 heartbeat_s: Optional[float] = None,
+                 slow_ms: float = 0.0):
+        from dnn_page_vectors_tpu.infer.partition import make_partition_specs
+        from dnn_page_vectors_tpu.infer.serve import SearchService
+        from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+        self.partition = int(partition)
+        self.partitions = int(partitions)
+        self.replica = int(replica)
+        self.connect = (connect[0], int(connect[1]))
+        self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
+                            else getattr(cfg.serve, "heartbeat_s", 0.5))
+        # drill hook (tests, the bench hedge drill): added per-request
+        # latency, so a deliberately slow replica provokes hedging
+        self.slow_ms = float(slow_ms)
+        if mesh is None:
+            from dnn_page_vectors_tpu.parallel.multihost import local_mesh
+            mesh = local_mesh(cfg.mesh)
+        # the worker's own service answers exactly ONE slice: its config
+        # is forced single-partition so no nested scatter can recurse
+        cfg1 = cfg.replace(serve=dataclasses.replace(
+            cfg.serve, partitions=1, replicas=1))
+        store = VectorStore(store_dir)
+        self.svc = SearchService(cfg1, MeshEmbedder(mesh), None, store,
+                                 preload_hbm_gb=0.0)
+        self.svc._preload_gb = preload_hbm_gb
+        specs = make_partition_specs(store.shards(), self.partitions,
+                                     hot_gb=cfg.serve.hot_postings_gb)
+        if self.partition >= len(specs):
+            raise ValueError(
+                f"partition {self.partition} does not exist: the balanced "
+                f"split of this store yields {len(specs)} partitions")
+        self.spec = specs[self.partition]
+        self.view = self.svc._build_view(store,
+                                         entries=list(self.spec.entries),
+                                         hot_gb=self.spec.hot_gb)
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()     # serializes frame writes
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                with self._wlock:
+                    transport.write_frame(self._sock, T_HEARTBEAT)
+            except OSError:
+                return
+
+    def run(self) -> None:
+        """Connect, register, serve until the gateway closes the
+        connection (or stop()). Blocking — the process entry point."""
+        sock = socket.create_connection(self.connect)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        transport.write_frame(sock, T_REGISTER, transport.encode_register(
+            self.partition, self.replica, os.getpid()))
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                              name=f"worker-p{self.partition}"
+                                   f"r{self.replica}-hb")
+        hb.start()
+        try:
+            while not self._stop.is_set():
+                frame = transport.read_frame(sock)
+                if frame is None:
+                    break
+                ftype, payload = frame
+                if ftype == T_VQUERY:
+                    self._answer(payload)
+                elif ftype == T_BYE:
+                    break
+                # anything else from the gateway is ignorable control
+        except (FrameError, OSError):
+            pass                  # gateway gone; the process's job is done
+        finally:
+            self._stop.set()
+            hb.join(timeout=2.0)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # graftcheck: hot
+    def _answer(self, payload: bytes) -> None:
+        req = transport.decode_vquery(payload)
+        t0 = time.perf_counter()
+        try:
+            if self.slow_ms > 0:
+                time.sleep(self.slow_ms / 1000.0)
+            k = req.k or self.svc.cfg.eval.recall_k
+            scores, ids, scan = self.svc._topk_view(
+                self.view, req.qv, req.qv.shape[0], k, req.nprobe or None)
+            if req.deadline_ms > 0 and \
+                    (time.perf_counter() - t0) * 1000.0 > req.deadline_ms:
+                # the budget died during compute: a late answer is waste
+                # on the wire — the gateway already fell back
+                ftype = T_SHED
+                out = transport.encode_shed(
+                    req.req_id, transport.SHED_DEADLINE,
+                    "deadline expired during partition compute")
+            else:
+                ftype = T_RESULT
+                out = transport.encode_result(req.req_id, scores, ids,
+                                              scan_bytes=scan)
+        except Exception as e:  # noqa: BLE001 — the request fails, the
+            # worker survives: per-request isolation like the batcher's
+            ftype = T_ERROR
+            out = transport.encode_error(req.req_id,
+                                         f"{type(e).__name__}: {e}")
+        with self._wlock:
+            transport.write_frame(self._sock, ftype, out)
+
+    def stop(self) -> None:
+        """Abrupt local shutdown (tests' stand-in for kill -9): close the
+        socket out from under the serve loop."""
+        self._stop.set()
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def run_partition_worker(cfg, store_dir: str, connect: str, partition: int,
+                         partitions: int, replica: int = 0,
+                         preload_hbm_gb: float = 4.0) -> Dict:
+    """`cli partition-worker` entry: build the worker (store + restricted
+    view + mesh, NO model or checkpoint), print one ready line, serve
+    until the gateway hangs up. Returns the exit record."""
+    host, _, port = connect.rpartition(":")
+    slow = float(os.environ.get("DPV_WORKER_SLOW_MS", "0") or 0.0)
+    worker = PartitionWorker(cfg, store_dir, (host or "127.0.0.1", int(port)),
+                             partition=partition, partitions=partitions,
+                             replica=replica, preload_hbm_gb=preload_hbm_gb,
+                             slow_ms=slow)
+    ready = {
+        "partition_worker": worker.partition,
+        "partitions": worker.partitions,
+        "replica": worker.replica,
+        "shards": list(worker.spec.shard_indices),
+        "rows": worker.spec.rows,
+        "pid": os.getpid(),
+    }
+    print(json.dumps(ready, sort_keys=True), flush=True)
+    worker.run()
+    return ready

@@ -122,6 +122,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from dnn_page_vectors_tpu.infer.bulk_embed import BulkEmbedder
+from dnn_page_vectors_tpu.infer.transport import DeadlineExceeded
 from dnn_page_vectors_tpu.infer.vector_store import VectorStore, read_ahead
 from dnn_page_vectors_tpu.ops.topk import (
     merge_shard_topk, sharded_topk, stage_shard, topk_over_store)
@@ -171,13 +172,20 @@ class _MicroBatcher:
         self._t.start()
 
     def submit(self, query: str, k: Optional[int],
-               nprobe: Optional[int] = None) -> Future:
+               nprobe: Optional[int] = None,
+               deadline: Optional[float] = None) -> Future:
+        """Enqueue one request. `deadline` is ABSOLUTE on the service
+        clock (svc._clock); admission-time shedding (expired / SLO
+        budget) happens in the CALLER (`SearchService._admit`) before
+        anything touches this queue — an already-hopeless request must
+        never consume queue capacity or a bucket slot."""
         fut: Future = Future()
         # capture the caller's active span HERE: the dispatcher runs on
         # another thread where the contextvar chain breaks, so the trace
         # context rides the queue explicitly (docs/OBSERVABILITY.md)
         ctx = self._svc.tracer.current()
-        self._q.put((query, (k, nprobe), fut, time.perf_counter(), ctx))
+        self._q.put((query, (k, nprobe), fut, time.perf_counter(), ctx,
+                     deadline))
         return fut
 
     def _run(self) -> None:
@@ -202,11 +210,32 @@ class _MicroBatcher:
             self._svc._adapt_window()
 
     def _dispatch(self, batch) -> None:
-        tracer = self._svc.tracer
+        svc = self._svc
+        tracer = svc.tracer
+        # THE DOOR (docs/SERVING.md "Network front end"): a request whose
+        # deadline expired while it queued is rejected here, BEFORE it
+        # can occupy a bucket slot — its caller gets DeadlineExceeded now
+        # instead of a result that arrives too late to use, and the
+        # requests that can still make their deadlines dispatch in a
+        # smaller (= faster) bucket. Shed requests are excluded from the
+        # queue-wait instrument: they never dispatched, so their waits
+        # must not steer the adaptive-window controller.
+        live = []
+        for item in batch:
+            deadline = item[5]
+            if deadline is not None and svc._clock() >= deadline:
+                item[2].set_exception(
+                    svc._shed_deadline("expired_in_queue", deadline,
+                                       trace=item[4]))
+            else:
+                live.append(item)
+        if not live:
+            return
+        batch = live
         now = time.perf_counter()
-        for _, _, _, t0, ctx in batch:
-            self._svc.profiler.add("queue_wait", now - t0)
-            self._svc._m_queue_wait.observe((now - t0) * 1000.0)
+        for _, _, _, t0, ctx, _ in batch:
+            svc.profiler.add("queue_wait", now - t0)
+            svc._m_queue_wait.observe((now - t0) * 1000.0)
             if ctx is not None:
                 # finished child stamped onto the REQUEST's tree: how long
                 # this request sat in the queue before its dispatch
@@ -215,9 +244,14 @@ class _MicroBatcher:
         # thread appends; readers consume after stop() joins the thread
         self.batch_sizes.append(len(batch))
         by_key: Dict[tuple, list] = {}
-        for query, key, fut, _, ctx in batch:
-            by_key.setdefault(key, []).append((query, fut, ctx))
+        for query, key, fut, _, ctx, deadline in batch:
+            by_key.setdefault(key, []).append((query, fut, ctx, deadline))
         for (k, nprobe), items in by_key.items():
+            # the shared dispatch honors the TIGHTEST deadline of the
+            # coalesced group: the RPC fan-out budgets per-partition
+            # waits against it
+            deadlines = [d for _, _, _, d in items if d is not None]
+            group_dl = min(deadlines) if deadlines else None
             try:
                 # the coalesced dispatch traces ONCE under a detached root
                 # (record=False: it only exists grafted into request
@@ -225,22 +259,22 @@ class _MicroBatcher:
                 # one measurement, N complete span trees
                 with tracer.trace("dispatch", record=False,
                                   batch_size=len(items)) as dsp:
-                    res = self._svc.search_many(
-                        [q for q, _, _ in items], k=k, nprobe=nprobe,
-                        _record=False)
+                    res = svc.search_many(
+                        [q for q, _, _, _ in items], k=k, nprobe=nprobe,
+                        _record=False, deadline=group_dl)
             except BaseException:  # noqa: BLE001 — isolate per request
-                for q, fut, ctx in items:
+                for q, fut, ctx, deadline in items:
                     try:
                         # per-request retry: re-activate the caller's span
                         # on THIS thread so retry spans nest under it
                         with tracer.use(ctx):
-                            fut.set_result(self._svc.search_many(
+                            fut.set_result(svc.search_many(
                                 [q], k=k, nprobe=nprobe,
-                                _record=False)[0])
+                                _record=False, deadline=deadline)[0])
                     except BaseException as e:  # noqa: BLE001
                         fut.set_exception(e)
                 continue
-            for (_, fut, ctx), r in zip(items, res):
+            for (_, fut, ctx, _), r in zip(items, res):
                 if ctx is not None:
                     ctx.adopt(dsp)
                 fut.set_result(r)
@@ -371,7 +405,8 @@ class SearchService:
                  store: VectorStore, preload_hbm_gb: float = 4.0,
                  snippet_chars: int = 160, query_batch: Optional[int] = None,
                  log=None, profiler: Optional[PipelineProfiler] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 clock=None):
         self.cfg = cfg
         self.embedder = embedder
         self.corpus = corpus
@@ -475,6 +510,28 @@ class SearchService:
                             if serve_cfg is not None else 8)
         self._m_replica_shed = reg.counter("serve.replica_shed")
         self._m_partition_degraded = reg.counter("serve.partition_degraded")
+        # -- over-the-wire serving (infer/transport.py, infer/server.py,
+        # infer/partition_host.py; docs/SERVING.md "Network front end") --
+        # The admission clock is injectable so deadline semantics are
+        # testable on a fake clock; everything else on the query path
+        # keeps using time.perf_counter directly.
+        self._clock = clock if clock is not None else time.perf_counter
+        # default per-request deadline budget applied at the network edge
+        # when a request carries none (0 = no deadline)
+        self._deadline_ms = (getattr(serve_cfg, "deadline_ms", 0.0)
+                             if serve_cfg is not None else 0.0)
+        # deadline-aware admission: a request shed at the door (expired,
+        # or the windowed queue-wait p99 says it cannot make its budget)
+        # counts here — and ONLY here; a shed is not an error
+        self._m_deadline_shed = reg.counter("serve.deadline_shed",
+                                            window_s=window_s)
+        # hedged fan-out + wire accounting (populated by the worker
+        # gateway / socket front end when transport serving is attached)
+        self._m_hedge_fired = reg.counter("serve.hedge_fired")
+        self._m_wire_bytes = reg.counter("serve.wire_bytes")
+        # the RPC fan-out (partition_host.WorkerGateway), attached by
+        # attach_gateway(); None = the in-process scatter-gather
+        self._fanout = None
         upd_cfg = getattr(cfg, "updates", None)
         self._rebuild_drift = (getattr(upd_cfg, "rebuild_drift", 0.25)
                                if upd_cfg is not None else 0.25)
@@ -649,6 +706,94 @@ class SearchService:
     def partition_set(self):
         """The live PartitionSet (None on a single-view service)."""
         return self._pset
+
+    # -- over-the-wire serving (docs/SERVING.md "Network front end") -------
+    @property
+    def deadline_sheds(self) -> int:
+        return self._m_deadline_shed.value
+
+    @property
+    def hedge_fires(self) -> int:
+        return self._m_hedge_fired.value
+
+    @property
+    def wire_bytes(self) -> int:
+        return self._m_wire_bytes.value
+
+    @property
+    def fanout(self):
+        """The attached WorkerGateway (None = in-process scatter)."""
+        return self._fanout
+
+    def attach_gateway(self, gateway) -> None:
+        """Wire a partition_host.WorkerGateway into the query path: the
+        scatter becomes an RPC fan-out to registered partition workers,
+        and replica routing derives health from worker LIVENESS
+        (heartbeats) on top of the in-process flags — a partition whose
+        worker connection died sheds with reason "liveness" exactly like
+        a restaging replica sheds today. Detach with attach_gateway(None)
+        (the gateway itself is closed by whoever opened it)."""
+        self._fanout = gateway
+        pset = gateway.partition_set if gateway is not None else self._pset
+        if pset is not None:
+            pset.set_liveness(
+                gateway.worker_alive if gateway is not None else None)
+
+    def default_deadline(self, deadline_ms: Optional[float] = None
+                         ) -> Optional[float]:
+        """Resolve a RELATIVE deadline budget (ms; None/<=0 = the
+        serve.deadline_ms default, which may itself be off) into an
+        ABSOLUTE deadline on the service clock, or None."""
+        dl = self._deadline_ms if deadline_ms is None else deadline_ms
+        if dl is None or dl <= 0:
+            return None
+        return self._clock() + dl / 1000.0
+
+    def _shed_deadline(self, reason: str, deadline: Optional[float],
+                       queue_wait_p99_ms: Optional[float] = None,
+                       trace=None) -> DeadlineExceeded:
+        """Count + record one admission shed and BUILD (not raise) the
+        exception: admission raises it, the micro-batch door sets it on
+        the shed request's future."""
+        self._m_deadline_shed.inc()
+        rem_ms = (None if deadline is None
+                  else round((deadline - self._clock()) * 1000.0, 3))
+        cur = trace if trace is not None else self.tracer.current()
+        attrs = {"reason": reason, "remaining_ms": rem_ms}
+        if queue_wait_p99_ms is not None:
+            attrs["queue_wait_p99_ms"] = round(queue_wait_p99_ms, 3)
+        self.registry.event(
+            "deadline_shed", attrs,
+            trace_id=getattr(cur, "trace_id", None))
+        msg = f"request shed at admission ({reason}"
+        if rem_ms is not None:
+            msg += f"; {rem_ms} ms remaining"
+        if queue_wait_p99_ms is not None:
+            msg += f"; queue-wait p99 {queue_wait_p99_ms:.1f} ms"
+        return DeadlineExceeded(msg + ")")
+
+    def _admit(self, deadline: Optional[float]) -> None:
+        """The admission-control ladder (docs/SERVING.md "Network front
+        end"): (1) a deadline that has ALREADY expired is shed
+        immediately — it must never consume queue capacity or a
+        micro-batch bucket slot; (2) SLO-budget shedding — when the
+        windowed queue-wait p99 (the same instrument the adaptive-window
+        controller reads) says the queue alone will eat the remaining
+        budget, the request cannot make its deadline and is shed at the
+        door instead of timing out after occupying a slot. Raises
+        DeadlineExceeded; no-deadline requests always admit."""
+        if deadline is None:
+            return
+        rem_ms = (deadline - self._clock()) * 1000.0
+        if rem_ms <= 0.0:
+            raise self._shed_deadline("expired", deadline)
+        if self._batcher is not None:
+            qw = self._m_queue_wait
+            if qw.window_count() >= 4:
+                p99 = qw.window_percentile(99)
+                if p99 > rem_ms:
+                    raise self._shed_deadline("slo_budget", deadline,
+                                              queue_wait_p99_ms=p99)
 
     @contextlib.contextmanager
     def _stage(self, name: str, **attrs):
@@ -1315,6 +1460,22 @@ class SearchService:
             rec["replica_shed"] = self.replica_shed
             rec["partition_degraded"] = self.partition_degraded_serves
             rec["partitions"] = self._pset.stats()
+        # over-the-wire serving block (docs/SERVING.md "Network front
+        # end") — emitted ONLY when non-empty, so every pre-transport
+        # consumer of this record (report-shape tests, dashboards, the
+        # loadgen trial records that copy it) stays byte-stable on an
+        # in-process service
+        transport: Dict = {}
+        if self.wire_bytes:
+            transport["wire_bytes"] = self.wire_bytes
+        if self.deadline_sheds:
+            transport["deadline_sheds"] = self.deadline_sheds
+        if self.hedge_fires:
+            transport["hedge_fires"] = self.hedge_fires
+        if self._fanout is not None:
+            transport.update(self._fanout.stats())
+        if transport:
+            rec["transport"] = transport
         if self._serve_index != "exact":
             # ANN counters + the active index config (the PR 3
             # cache-counter pattern: flat keys, always present when the
@@ -1396,7 +1557,9 @@ class SearchService:
         self.warm_latency_ms = lat.percentile_ms(50)
 
     def search(self, query: str, k: Optional[int] = None,
-               nprobe: Optional[int] = None) -> List[Dict]:
+               nprobe: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               deadline: Optional[float] = None) -> List[Dict]:
         """One query -> top-k results. With the micro-batcher running
         (start_batcher), the call enqueues and blocks on its future —
         concurrent callers share dispatches; otherwise it is a direct
@@ -1405,16 +1568,39 @@ class SearchService:
         the batched path's trace follows the request THROUGH the
         dispatcher thread (queue_wait + the adopted shared dispatch).
         `nprobe` overrides serve.nprobe for this request on an IVF
-        service (the batcher coalesces per distinct (k, nprobe))."""
+        service (the batcher coalesces per distinct (k, nprobe)).
+
+        `deadline_ms` is this request's RELATIVE latency budget (None =
+        the serve.deadline_ms default; <= 0 disables); `deadline` is an
+        ABSOLUTE deadline on the service clock, already anchored — the
+        network front end resolves each request's budget at frame
+        receipt and passes it through here, so a request that aged out
+        between the socket and this thread is ALREADY expired at
+        admission. A request that cannot make its deadline is shed at
+        admission — or at the micro-batch door if it expires while
+        queued — with DeadlineExceeded; sheds count in
+        serve.deadline_shed, never in serve.errors (docs/SERVING.md
+        "Network front end")."""
+        if deadline is None:
+            deadline = self.default_deadline(deadline_ms)
+        # admission happens BEFORE the queue: a shed request never
+        # consumes queue capacity or a bucket slot (raises out of here)
+        self._admit(deadline)
         b = self._batcher
         if b is None:
-            return self.search_many([query], k=k, nprobe=nprobe)[0]
+            return self.search_many([query], k=k, nprobe=nprobe,
+                                    deadline=deadline)[0]
         t0 = time.perf_counter()
         try:
             with self.tracer.trace("search",
                                    k=k or self.cfg.eval.recall_k,
                                    query=self._normalize(query)[:80]):
-                res = b.submit(query, k, nprobe).result()
+                res = b.submit(query, k, nprobe, deadline=deadline).result()
+        except DeadlineExceeded:
+            # the micro-batch door shed it (expired while queued): a
+            # deliberate availability decision, already counted in
+            # serve.deadline_shed — not a serving error
+            raise
         except BaseException:
             self._m_errors.inc()
             raise
@@ -1424,7 +1610,8 @@ class SearchService:
 
     def search_many(self, queries: Sequence[str], k: Optional[int] = None,
                     nprobe: Optional[int] = None,
-                    *, _record: bool = True) -> List[List[Dict]]:
+                    *, _record: bool = True,
+                    deadline: Optional[float] = None) -> List[List[Dict]]:
         """Vectorized multi-query search: one result list per query, in
         order. Queries fill the compiled `query_batch` bucket (larger lists
         tile over full buckets — one compiled program regardless of count);
@@ -1449,7 +1636,8 @@ class SearchService:
         t0 = time.perf_counter()
         try:
             with self.tracer.root_or_span("search_many", n_queries=n, k=k):
-                out = self._search_view(view, list(queries), n, k, nprobe)
+                out = self._search_view(view, list(queries), n, k, nprobe,
+                                        deadline=deadline)
         except BaseException:
             if _record:
                 self._m_errors.inc(n)
@@ -1462,9 +1650,19 @@ class SearchService:
 
     def _search_view(self, view: "_ServeView", queries: List[str],
                      n: int, k: int,
-                     nprobe: Optional[int] = None) -> List[List[Dict]]:
+                     nprobe: Optional[int] = None,
+                     deadline: Optional[float] = None) -> List[List[Dict]]:
         qv = self._embed_queries_cached(queries)
-        if self._pset is not None:
+        fanout = self._fanout
+        if fanout is not None and fanout.active():
+            # over-the-wire scatter (infer/partition_host.py): the RPC
+            # fan-out to registered partition workers, with per-partition
+            # deadlines, hedged requests, and a per-partition LOCAL
+            # fallback that keeps results byte-identical when a worker
+            # dies mid-request
+            best_s, best_i = fanout.topk(qv, n, k, nprobe,
+                                         deadline=deadline)
+        elif self._pset is not None:
             # partitioned scatter-gather (infer/partition.py): the
             # coalesced bucket's query matrix broadcasts ONCE to every
             # partition; each answers its local top-k over only its shard
@@ -1476,16 +1674,21 @@ class SearchService:
             return [self._format(best_s[i], best_i[i]) for i in range(n)]
 
     def topk_vectors(self, qv: np.ndarray, k: Optional[int] = None,
-                     nprobe: Optional[int] = None
+                     nprobe: Optional[int] = None,
+                     deadline: Optional[float] = None
                      ) -> tuple:
         """Raw retrieval for PRE-COMPUTED query vectors: (scores [n, k]
         fp32, page_ids [n, k] int64, -1-padded), skipping tokenize/encode
         and snippet formatting. The bench's host-simulated partitioned
-        phase and vector-level tests drive the full serving top-k
-        (partitioned or single-view) through this without a model."""
+        phase, the network front end's vector protocol, and vector-level
+        tests drive the full serving top-k (RPC fan-out, partitioned, or
+        single-view) through this without a model."""
         k = k or self.cfg.eval.recall_k
         qv = np.asarray(qv, np.float32)
         n = qv.shape[0]
+        fanout = self._fanout
+        if fanout is not None and fanout.active():
+            return fanout.topk(qv, n, k, nprobe, deadline=deadline)
         if self._pset is not None:
             return self._pset.topk(qv, n, k, nprobe)
         s, i, _ = self._topk_view(self._view, qv, n, k, nprobe)

@@ -1,0 +1,286 @@
+"""The async socket front end (docs/SERVING.md "Network front end").
+
+`SearchService` becomes a network service here and ONLY here: an asyncio
+server speaking the `infer/transport.py` length-prefixed protocol —
+connection handling on the host event loop, zero change to the device
+path. A client connection sends `T_QUERY` (text) or `T_VQUERY` (raw
+query vectors) frames and gets back `T_RESULT` (scores/ids/scan bytes),
+`T_SHED` (the request was deliberately rejected at admission), or
+`T_ERROR`.
+
+Admission control happens AT THE SOCKET, before a request can touch the
+micro-batcher (`SearchService._admit`): a deadline that already expired,
+or one the windowed queue-wait p99 says cannot be met, is answered with
+`T_SHED` immediately — it never consumes queue capacity or a bucket
+slot, and it counts in `serve.deadline_shed` (a `deadline_shed` event
+rides the ring), never in `serve.errors`. Requests that admit carry
+their absolute deadline INTO the batcher, where the micro-batch door
+sheds any that expire while queued (docs/SERVING.md).
+
+Protocol robustness: a garbage header, an unknown frame type, or an
+oversize length is REJECTED — one best-effort `T_ERROR` frame, then the
+connection closes. Truncation mid-frame closes the connection. A
+malformed peer can never park a handler coroutine on a half-read frame.
+
+Tracing: every request runs under a root span opened AT THE SOCKET
+(`socket` span, protocol + query count attrs). The dispatch hops to an
+executor thread with an explicit `tracer.use` hand-off, so the
+micro-batcher's captured context — and therefore the grafted
+queue_wait/dispatch subtree — hangs under the socket root: one span tree
+from the accept to the device dispatch and back
+(docs/OBSERVABILITY.md)."""
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dnn_page_vectors_tpu.infer import transport
+from dnn_page_vectors_tpu.infer.transport import (
+    DeadlineExceeded, FrameError, T_QUERY, T_RESULT, T_SHED, T_ERROR,
+    T_VQUERY)
+
+
+def parse_listen(listen: str) -> Tuple[str, int]:
+    """'host:port' -> (host, port); port 0 = ephemeral."""
+    host, _, port = str(listen).rpartition(":")
+    return host or "127.0.0.1", int(port or 0)
+
+
+def _results_to_arrays(results: List[List[dict]], k: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Formatted per-query result lists -> fixed [n, k] score/id arrays
+    (-1-id padding past each query's real hit count)."""
+    n = len(results)
+    scores = np.zeros((n, k), np.float32)
+    ids = np.full((n, k), -1, np.int64)
+    for qi, res in enumerate(results):
+        for slot, hit in enumerate(res[:k]):
+            scores[qi, slot] = hit["score"]
+            ids[qi, slot] = hit["page_id"]
+    return scores, ids
+
+
+class SearchServer:
+    """Asyncio front end over one `SearchService`. Run it on the caller's
+    loop (`await start()`) or host it on a background thread
+    (`start_background()` — the cli/loadgen shape; `close()` stops it)."""
+
+    def __init__(self, svc, host: Optional[str] = None,
+                 port: Optional[int] = None, executor_workers: int = 32):
+        serve_cfg = getattr(svc.cfg, "serve", None)
+        listen = (getattr(serve_cfg, "listen", "127.0.0.1:0")
+                  if serve_cfg is not None else "127.0.0.1:0")
+        cfg_host, cfg_port = parse_listen(listen)
+        self.svc = svc
+        self.host = host if host is not None else cfg_host
+        self.port = port if port is not None else cfg_port
+        self._executor = ThreadPoolExecutor(
+            max_workers=executor_workers,
+            thread_name_prefix="serve-socket")
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> "SearchServer":
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.host, self.port = self._server.sockets[0].getsockname()[:2]
+        return self
+
+    def start_background(self) -> "SearchServer":
+        """Host the server on its own event-loop thread; returns once the
+        listener is bound (self.port carries the ephemeral port)."""
+        started = threading.Event()
+        failed: List[BaseException] = []
+
+        def _run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            try:
+                server = loop.run_until_complete(asyncio.start_server(
+                    self._handle, self.host, self.port))
+            except BaseException as e:  # noqa: BLE001 — surface bind errors
+                failed.append(e)
+                started.set()
+                loop.close()
+                return
+            self._server = server
+            self.host, self.port = server.sockets[0].getsockname()[:2]
+            started.set()
+            try:
+                loop.run_forever()
+            finally:
+                server.close()
+                loop.run_until_complete(server.wait_closed())
+                loop.close()
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="serve-socket-loop")
+        self._thread.start()
+        started.wait()
+        if failed:
+            raise failed[0]
+        return self
+
+    def close(self) -> None:
+        loop = self._loop
+        if loop is not None and self._thread is not None:
+            async def _shutdown() -> None:
+                # stop accepting, then cancel the per-connection handler
+                # tasks still parked on idle client reads — a close must
+                # not leak destroyed-pending tasks
+                if self._server is not None:
+                    self._server.close()
+                    await self._server.wait_closed()
+                tasks = [t for t in asyncio.all_tasks()
+                         if t is not asyncio.current_task()]
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    _shutdown(), loop).result(timeout=10.0)
+            except Exception:  # noqa: BLE001 — stop the loop regardless
+                pass
+            loop.call_soon_threadsafe(loop.stop)
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._executor.shutdown(wait=False)
+
+    # -- per-connection handler -------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        svc = self.svc
+        try:
+            while True:
+                frame = await transport.read_frame_async(reader)
+                if frame is None:
+                    break
+                ftype, payload = frame
+                svc._m_wire_bytes.inc(transport.HEADER.size + len(payload))
+                if ftype == T_QUERY:
+                    req = transport.decode_query(payload)
+                    await self._answer(writer, req, vectors=False)
+                elif ftype == T_VQUERY:
+                    req = transport.decode_vquery(payload)
+                    await self._answer(writer, req, vectors=True)
+                else:
+                    await self._write(writer, T_ERROR, transport.encode_error(
+                        0, f"unexpected frame type {ftype} on a client "
+                           "connection"))
+                    break
+        except FrameError as e:
+            # the reject path the fuzz tests pin: one best-effort error
+            # frame, then the connection CLOSES — never a hung peer
+            try:
+                await self._write(writer, T_ERROR,
+                                  transport.encode_error(0, str(e)))
+            except (ConnectionError, OSError):
+                pass
+        except asyncio.CancelledError:
+            pass                  # server shutdown cancels idle handlers
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (asyncio.CancelledError, ConnectionError, OSError):
+                pass
+
+    async def _write(self, writer: asyncio.StreamWriter, ftype: int,
+                     payload: bytes) -> None:
+        frame = transport.pack_frame(ftype, payload)
+        writer.write(frame)
+        self.svc._m_wire_bytes.inc(len(frame))
+        await writer.drain()
+
+    async def _answer(self, writer: asyncio.StreamWriter, req,
+                      vectors: bool) -> None:
+        svc = self.svc
+        n = req.qv.shape[0] if vectors else len(req.queries)
+        k = req.k or svc.cfg.eval.recall_k
+        nprobe = req.nprobe or None
+        loop = asyncio.get_running_loop()
+        # the span tree starts AT THE SOCKET: the executor hop below
+        # re-activates this root on the dispatch thread, so the batcher's
+        # captured context (queue_wait + the shared dispatch subtree)
+        # hangs under it
+        with svc.tracer.trace("socket",
+                              protocol="vquery" if vectors else "query",
+                              n_queries=n, k=k) as root:
+            deadline = svc.default_deadline(
+                req.deadline_ms if req.deadline_ms > 0 else None)
+            try:
+                scores, ids, scan = await loop.run_in_executor(
+                    self._executor,
+                    lambda: self._dispatch_blocking(root, req, vectors, n,
+                                                    k, nprobe, deadline))
+            except DeadlineExceeded as e:
+                await self._write(writer, T_SHED, transport.encode_shed(
+                    req.req_id, transport.SHED_DEADLINE, str(e)))
+                return
+            except Exception as e:  # noqa: BLE001 — per-request isolation
+                await self._write(writer, T_ERROR, transport.encode_error(
+                    req.req_id, f"{type(e).__name__}: {e}"))
+                return
+            await self._write(writer, T_RESULT, transport.encode_result(
+                req.req_id, scores, ids, scan_bytes=scan))
+
+    def _dispatch_blocking(self, root, req, vectors: bool, n: int, k: int,
+                           nprobe: Optional[int],
+                           deadline: Optional[float]):
+        """The blocking half, on an executor thread: admission, then the
+        batcher (single text query) or a direct dispatch; records the
+        request into the windowed serving instruments exactly once."""
+        svc = self.svc
+        with svc.tracer.use(root):
+            # admission control at the door (raises DeadlineExceeded;
+            # already counted + evented by _admit)
+            svc._admit(deadline)
+            t0 = time.perf_counter()
+            try:
+                if vectors:
+                    out = svc.topk_vectors(req.qv, k=k, nprobe=nprobe,
+                                           deadline=deadline)
+                    scores, ids = out[0], out[1]
+                    scan = int(out[2]) if len(out) > 2 else 0
+                elif svc._batcher is not None and n == 1:
+                    res = [svc._batcher.submit(
+                        req.queries[0], req.k or None, nprobe,
+                        deadline=deadline).result()]
+                    scores, ids = _results_to_arrays(res, k)
+                    scan = 0
+                else:
+                    res = svc.search_many(list(req.queries),
+                                          k=req.k or None, nprobe=nprobe,
+                                          _record=False, deadline=deadline)
+                    scores, ids = _results_to_arrays(res, k)
+                    scan = 0
+            except DeadlineExceeded:
+                # shed at the micro-batch door: counted there, not an
+                # error
+                raise
+            except BaseException:
+                svc._m_errors.inc(n)
+                raise
+            svc._m_requests.inc(n)
+            svc._m_latency.observe((time.perf_counter() - t0) * 1000.0, n=n)
+            return scores, ids, scan
+
+
+def serve_in_background(svc, host: Optional[str] = None,
+                        port: Optional[int] = None) -> SearchServer:
+    """One-call server hosting for cli/bench/tests: binds (serve.listen
+    unless overridden), runs the loop on a daemon thread, returns the
+    handle (`.host` / `.port` / `.close()`)."""
+    return SearchServer(svc, host=host, port=port).start_background()

@@ -1,0 +1,463 @@
+"""Over-the-wire serving protocol (docs/SERVING.md "Network front end").
+
+One compact length-prefixed binary framing for every socket in the
+serving fleet — the client edge (`infer/server.py`, text queries in,
+scores/ids out) and the partition RPC hop (`infer/partition_host.py`,
+query vectors fanned out to partition workers). Binary because the hot
+payloads ARE arrays (a [n, D] fp32 query block out, [n, k] fp32 scores +
+[n, k] int64 ids back): raw little-endian array bytes round-trip exactly,
+so over-the-wire results can be pinned BYTE-identical to the in-process
+scatter-gather, and a query costs tens of bytes of framing instead of a
+JSON re-encode of its vectors.
+
+Frame layout (9-byte header, network byte order):
+
+    +--------+--------+----------------+=================+
+    | magic  | type   | payload length |  payload bytes  |
+    | u32    | u8     | u32            |  (type-specific)|
+    +--------+--------+----------------+=================+
+
+`magic` (0x44505631, "DPV1") carries the protocol version; a reader that
+sees anything else is talking to the wrong peer (or a corrupted stream)
+and must REJECT — close the connection — rather than resynchronize.
+`payload length` is bounded by MAX_FRAME (64 MiB): an oversize length is
+rejected BEFORE any payload read, so a garbage header can never park a
+connection in a multi-gigabyte recv. Truncation (EOF mid-frame) raises
+`FrameError` — torn responses are indistinguishable from a crashed peer
+and are treated exactly like one (docs/ROBUSTNESS.md).
+
+Message types:
+
+    T_QUERY      client -> front end: text queries + k/nprobe/deadline
+    T_VQUERY     front end -> partition worker (and vector-mode clients):
+                 an fp32 query block + k/nprobe/deadline
+    T_RESULT     scores [n, k] f32 + page ids [n, k] i64 + scan bytes
+    T_SHED       admission rejection (deadline/SLO budget) — NOT an error
+    T_ERROR      server-side failure, message attached
+    T_REGISTER   partition worker hello: (partition, replica, pid)
+    T_HEARTBEAT  worker liveness tick (empty payload)
+    T_BYE        clean worker deregistration (empty payload)
+
+Deadlines travel as RELATIVE remaining milliseconds (not absolute
+timestamps): the two ends of a socket do not share a clock, and a
+relative budget re-anchors on the receiver's own monotonic clock at
+receipt — clock skew costs at most the in-flight network time.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = 0x44505631            # "DPV1": protocol id + version in one word
+MAX_FRAME = 64 * 2 ** 20      # reject oversize lengths before any recv
+
+HEADER = struct.Struct("!IBI")            # magic, type, payload length
+
+T_QUERY = 1
+T_VQUERY = 2
+T_RESULT = 3
+T_SHED = 4
+T_ERROR = 5
+T_REGISTER = 6
+T_HEARTBEAT = 7
+T_BYE = 8
+
+_TYPES = {T_QUERY, T_VQUERY, T_RESULT, T_SHED, T_ERROR, T_REGISTER,
+          T_HEARTBEAT, T_BYE}
+
+# shed reason codes (T_SHED payload)
+SHED_DEADLINE = 1             # deadline expired / cannot be met
+SHED_QUEUE = 2                # admission queue budget exceeded
+
+_QUERY_HEAD = struct.Struct("!QdiiH")     # req id, deadline ms, k, nprobe, nq
+_VQUERY_HEAD = struct.Struct("!QdiiHH")   # ... + n, dim
+_RESULT_HEAD = struct.Struct("!QQHH")     # req id, scan bytes, n, k
+_SHED_HEAD = struct.Struct("!QB")         # req id, reason code
+_ERROR_HEAD = struct.Struct("!Q")         # req id
+_REGISTER_HEAD = struct.Struct("!IIQ")    # partition, replica, pid
+
+_REQ_IDS = itertools.count(1)
+
+
+def next_request_id() -> int:
+    return next(_REQ_IDS)
+
+
+class FrameError(ValueError):
+    """The stream is not speaking this protocol (bad magic/type), the
+    frame is oversize, or it was truncated mid-read. The only safe
+    response is to reject: answer nothing further and close."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request was shed at admission (or at the micro-batch door)
+    because its deadline had expired or could not be met. A shed is a
+    deliberate availability decision, not a server error — it counts in
+    `serve.deadline_shed`, never in `serve.errors`."""
+
+
+class RemoteError(RuntimeError):
+    """The remote end answered T_ERROR: the failure happened there."""
+
+
+# ---------------------------------------------------------------------------
+# payload codecs (pure functions of bytes — the fuzz-test surface)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class QueryRequest:
+    req_id: int
+    deadline_ms: float            # remaining budget; <= 0 means none
+    k: int                        # 0 means the server default
+    nprobe: int                   # 0 means the server default
+    queries: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class VectorRequest:
+    req_id: int
+    deadline_ms: float
+    k: int
+    nprobe: int
+    qv: np.ndarray                # [n, dim] float32
+
+
+def encode_query(req_id: int, queries: Sequence[str], k: int = 0,
+                 nprobe: int = 0, deadline_ms: float = 0.0) -> bytes:
+    if not 0 < len(queries) <= 0xFFFF:
+        raise ValueError(f"1..65535 queries per frame, got {len(queries)}")
+    parts = [_QUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
+                              int(nprobe), len(queries))]
+    for q in queries:
+        raw = q.encode("utf-8")
+        if len(raw) > 0xFFFF:
+            raise ValueError("query text exceeds 65535 utf-8 bytes")
+        parts.append(struct.pack("!H", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_query(payload: bytes) -> QueryRequest:
+    if len(payload) < _QUERY_HEAD.size:
+        raise FrameError("query frame shorter than its fixed header")
+    req_id, deadline_ms, k, nprobe, nq = _QUERY_HEAD.unpack_from(payload)
+    off = _QUERY_HEAD.size
+    queries: List[str] = []
+    for _ in range(nq):
+        if off + 2 > len(payload):
+            raise FrameError("query frame truncated inside a length prefix")
+        (ln,) = struct.unpack_from("!H", payload, off)
+        off += 2
+        if off + ln > len(payload):
+            raise FrameError("query frame truncated inside a query string")
+        try:
+            queries.append(payload[off: off + ln].decode("utf-8"))
+        except UnicodeDecodeError as e:
+            raise FrameError(f"query text is not utf-8: {e}") from None
+        off += ln
+    if off != len(payload):
+        raise FrameError(f"{len(payload) - off} trailing bytes after the "
+                         "last query")
+    return QueryRequest(req_id, deadline_ms, k, nprobe, tuple(queries))
+
+
+def encode_vquery(req_id: int, qv: np.ndarray, k: int = 0, nprobe: int = 0,
+                  deadline_ms: float = 0.0) -> bytes:
+    qv = np.ascontiguousarray(qv, dtype="<f4")
+    if qv.ndim != 2 or not 0 < qv.shape[0] <= 0xFFFF \
+            or not 0 < qv.shape[1] <= 0xFFFF:
+        raise ValueError(f"query block must be [1..65535, 1..65535], "
+                         f"got {qv.shape}")
+    return (_VQUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
+                              int(nprobe), qv.shape[0], qv.shape[1])
+            + qv.tobytes())
+
+
+def decode_vquery(payload: bytes) -> VectorRequest:
+    if len(payload) < _VQUERY_HEAD.size:
+        raise FrameError("vquery frame shorter than its fixed header")
+    req_id, deadline_ms, k, nprobe, n, dim = _VQUERY_HEAD.unpack_from(payload)
+    body = payload[_VQUERY_HEAD.size:]
+    want = n * dim * 4
+    if len(body) != want:
+        raise FrameError(f"vquery block carries {len(body)} bytes for a "
+                         f"[{n}, {dim}] f32 matrix ({want} expected)")
+    if n == 0 or dim == 0:
+        raise FrameError("vquery block is empty")
+    qv = np.frombuffer(body, dtype="<f4").reshape(n, dim).astype(
+        np.float32, copy=True)
+    return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
+
+
+def encode_result(req_id: int, scores: np.ndarray, ids: np.ndarray,
+                  scan_bytes: int = 0) -> bytes:
+    scores = np.ascontiguousarray(scores, dtype="<f4")
+    ids = np.ascontiguousarray(ids, dtype="<i8")
+    if scores.shape != ids.shape or scores.ndim != 2:
+        raise ValueError(f"scores {scores.shape} / ids {ids.shape} must be "
+                         "matching [n, k]")
+    n, k = scores.shape
+    return (_RESULT_HEAD.pack(req_id, int(scan_bytes), n, k)
+            + scores.tobytes() + ids.tobytes())
+
+
+def decode_result(payload: bytes
+                  ) -> Tuple[int, np.ndarray, np.ndarray, int]:
+    """-> (req_id, scores [n, k] f32, ids [n, k] i64, scan_bytes)."""
+    if len(payload) < _RESULT_HEAD.size:
+        raise FrameError("result frame shorter than its fixed header")
+    req_id, scan_bytes, n, k = _RESULT_HEAD.unpack_from(payload)
+    body = payload[_RESULT_HEAD.size:]
+    want = n * k * (4 + 8)
+    if len(body) != want:
+        raise FrameError(f"result block carries {len(body)} bytes for "
+                         f"[{n}, {k}] scores+ids ({want} expected)")
+    cut = n * k * 4
+    scores = np.frombuffer(body[:cut], dtype="<f4").reshape(n, k).astype(
+        np.float32, copy=True)
+    ids = np.frombuffer(body[cut:], dtype="<i8").reshape(n, k).astype(
+        np.int64, copy=True)
+    return req_id, scores, ids, int(scan_bytes)
+
+
+def encode_shed(req_id: int, code: int, reason: str) -> bytes:
+    return _SHED_HEAD.pack(req_id, code) + reason.encode("utf-8")[:512]
+
+
+def decode_shed(payload: bytes) -> Tuple[int, int, str]:
+    if len(payload) < _SHED_HEAD.size:
+        raise FrameError("shed frame shorter than its fixed header")
+    req_id, code = _SHED_HEAD.unpack_from(payload)
+    return req_id, code, payload[_SHED_HEAD.size:].decode(
+        "utf-8", errors="replace")
+
+
+def encode_error(req_id: int, message: str) -> bytes:
+    return _ERROR_HEAD.pack(req_id) + message.encode("utf-8")[:2048]
+
+
+def decode_error(payload: bytes) -> Tuple[int, str]:
+    if len(payload) < _ERROR_HEAD.size:
+        raise FrameError("error frame shorter than its fixed header")
+    (req_id,) = _ERROR_HEAD.unpack_from(payload)
+    return req_id, payload[_ERROR_HEAD.size:].decode(
+        "utf-8", errors="replace")
+
+
+def encode_register(partition: int, replica: int, pid: int) -> bytes:
+    return _REGISTER_HEAD.pack(partition, replica, pid)
+
+
+def decode_register(payload: bytes) -> Tuple[int, int, int]:
+    if len(payload) != _REGISTER_HEAD.size:
+        raise FrameError("register frame has the wrong size")
+    return _REGISTER_HEAD.unpack(payload)
+
+
+# ---------------------------------------------------------------------------
+# framing over sync sockets (partition RPC hop, client library)
+# ---------------------------------------------------------------------------
+
+def _check_header(hdr: bytes) -> Tuple[int, int]:
+    magic, ftype, length = HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic 0x{magic:08x} (not a DPV1 peer)")
+    if ftype not in _TYPES:
+        raise FrameError(f"unknown frame type {ftype}")
+    if length > MAX_FRAME:
+        raise FrameError(f"frame length {length} exceeds MAX_FRAME "
+                         f"{MAX_FRAME}")
+    return ftype, length
+
+
+def pack_frame(ftype: int, payload: bytes = b"") -> bytes:
+    return HEADER.pack(MAGIC, ftype, len(payload)) + payload
+
+
+def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on clean EOF BEFORE the first byte,
+    FrameError on EOF mid-read (a torn frame)."""
+    if n == 0:
+        return b""
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            if got == 0:
+                return None
+            raise FrameError(f"stream truncated: EOF after {got}/{n} bytes")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+    """-> (type, payload), or None on clean EOF at a frame boundary.
+    Garbage/oversize headers and truncation raise FrameError."""
+    hdr = read_exact(sock, HEADER.size)
+    if hdr is None:
+        return None
+    ftype, length = _check_header(hdr)
+    payload = read_exact(sock, length)
+    if payload is None:
+        raise FrameError("stream truncated between header and payload")
+    return ftype, payload
+
+
+def write_frame(sock: socket.socket, ftype: int, payload: bytes = b"",
+                counter=None) -> int:
+    """Send one frame; returns the wire bytes written (header included).
+    `counter` (a telemetry Counter) accumulates wire-byte accounting."""
+    frame = pack_frame(ftype, payload)
+    sock.sendall(frame)
+    if counter is not None:
+        counter.inc(len(frame))
+    return len(frame)
+
+
+# ---------------------------------------------------------------------------
+# framing over asyncio streams (the front-end server)
+# ---------------------------------------------------------------------------
+
+async def read_frame_async(reader: asyncio.StreamReader
+                           ) -> Optional[Tuple[int, bytes]]:
+    """Asyncio twin of read_frame: (type, payload), None on clean EOF,
+    FrameError on garbage/oversize/truncation."""
+    try:
+        hdr = await reader.readexactly(HEADER.size)
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            return None
+        raise FrameError(
+            f"stream truncated inside a header ({len(e.partial)}/"
+            f"{HEADER.size} bytes)") from None
+    ftype, length = _check_header(hdr)
+    try:
+        payload = await reader.readexactly(length)
+    except asyncio.IncompleteReadError as e:
+        raise FrameError(f"stream truncated: EOF after {len(e.partial)}/"
+                         f"{length} payload bytes") from None
+    return ftype, payload
+
+
+# ---------------------------------------------------------------------------
+# the client library (loadgen socket mode, cli loadtest --transport socket)
+# ---------------------------------------------------------------------------
+
+class SocketSearchClient:
+    """Blocking client for the front-end protocol. Thread-safe the same
+    way the loadgen driver is threaded: each calling thread gets its own
+    connection (thread-local), so concurrent trial workers never
+    interleave frames on one socket. `search()` mirrors
+    `SearchService.search`'s signature, so `loadgen/driver.py:run_trial`
+    can point its issue loop at a client unchanged."""
+
+    def __init__(self, host: str, port: int, deadline_ms: float = 0.0,
+                 timeout_s: float = 30.0):
+        self.host = host
+        self.port = int(port)
+        self.deadline_ms = float(deadline_ms)
+        self.timeout_s = float(timeout_s)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []   # guarded-by: _lock
+
+    def _conn(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=self.timeout_s)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = sock
+            with self._lock:
+                self._conns.append(sock)
+        return sock
+
+    def _roundtrip(self, ftype: int, payload: bytes,
+                   req_id: int) -> Tuple[np.ndarray, np.ndarray, int]:
+        sock = self._conn()
+        try:
+            write_frame(sock, ftype, payload)
+            frame = read_frame(sock)
+        except (OSError, FrameError):
+            # a broken connection must not poison the thread's next call
+            self._drop_local()
+            raise
+        if frame is None:
+            self._drop_local()
+            raise RemoteError("server closed the connection mid-request")
+        rtype, body = frame
+        if rtype == T_RESULT:
+            rid, scores, ids, scan = decode_result(body)
+            if rid != req_id:
+                self._drop_local()
+                raise RemoteError(f"response for request {rid} arrived on "
+                                  f"request {req_id}'s connection")
+            return scores, ids, scan
+        if rtype == T_SHED:
+            _, code, reason = decode_shed(body)
+            raise DeadlineExceeded(reason or f"shed (code {code})")
+        if rtype == T_ERROR:
+            _, msg = decode_error(body)
+            raise RemoteError(msg)
+        self._drop_local()
+        raise FrameError(f"unexpected frame type {rtype} in response")
+
+    def _drop_local(self) -> None:
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            self._local.sock = None
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def search(self, query: str, k: Optional[int] = None,
+               nprobe: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> List[Dict]:
+        """One text query over the wire -> the same [{page_id, score}]
+        shape a local `SearchService.search` returns (snippets stay
+        server-side; the wire carries scores/ids)."""
+        scores, ids, _ = self.search_raw([query], k=k, nprobe=nprobe,
+                                         deadline_ms=deadline_ms)
+        return [{"page_id": int(i), "score": float(s)}
+                for s, i in zip(scores[0], ids[0]) if i >= 0]
+
+    def search_raw(self, queries: Sequence[str], k: Optional[int] = None,
+                   nprobe: Optional[int] = None,
+                   deadline_ms: Optional[float] = None
+                   ) -> Tuple[np.ndarray, np.ndarray, int]:
+        req_id = next_request_id()
+        dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        payload = encode_query(req_id, list(queries), k=k or 0,
+                               nprobe=nprobe or 0, deadline_ms=dl)
+        return self._roundtrip(T_QUERY, payload, req_id)
+
+    def topk_vectors(self, qv: np.ndarray, k: Optional[int] = None,
+                     nprobe: Optional[int] = None,
+                     deadline_ms: Optional[float] = None
+                     ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Raw vector retrieval over the wire (the model-free twin of
+        `SearchService.topk_vectors`): (scores, ids, scan_bytes)."""
+        req_id = next_request_id()
+        dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        payload = encode_vquery(req_id, qv, k=k or 0, nprobe=nprobe or 0,
+                                deadline_ms=dl)
+        return self._roundtrip(T_VQUERY, payload, req_id)
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -43,6 +43,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
 
+from dnn_page_vectors_tpu.infer.transport import DeadlineExceeded
 from dnn_page_vectors_tpu.loadgen.workload import Mutator, Workload
 
 
@@ -64,6 +65,14 @@ def snapshot_line(svc, extra: Optional[Dict] = None) -> str:
         "recompiles": m.get("serve_recompiles"),
         "degraded": m.get("serve_degraded"),
     }
+    # over-the-wire block (docs/SERVING.md "Network front end"): only
+    # when the service reports one — an in-process service's tick stays
+    # byte-identical to the pre-transport format
+    transport = m.get("transport") or {}
+    rec["wire_bytes"] = transport.get("wire_bytes")
+    rec["deadline_sheds"] = transport.get("deadline_sheds")
+    rec["hedge_fires"] = transport.get("hedge_fires")
+    rec["workers_live"] = transport.get("workers_live")
     if extra:
         rec.update(extra)
     return json.dumps({k: v for k, v in rec.items() if v is not None})
@@ -75,23 +84,37 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
               clock: Callable[[], float] = time.monotonic,
               sleep: Callable[[float], None] = time.sleep,
               progress: Optional[Callable[[str], None]] = None,
-              progress_every_s: float = 0.0) -> Dict:
+              progress_every_s: float = 0.0, client=None) -> Dict:
     """One timed trial at one offered load; returns the trial record.
 
     `offered` is a rate (qps) for open-loop workloads and a worker count
     for closed-loop ones. `queries` maps the workload's distinct query
-    ids onto real query texts (`query_id % len(queries)`)."""
+    ids onto real query texts (`query_id % len(queries)`).
+
+    `client` (a transport.SocketSearchClient, or anything with the same
+    `search(query, k, nprobe)` shape) reroutes the ISSUE path over the
+    wire while every measured number still reads from `svc`'s registry —
+    qps@p99 then covers the full network path: framing, admission,
+    batcher, RPC fan-out, and the socket round trip back."""
     ev0 = len(svc.registry.events()) if hasattr(svc, "registry") else 0
     mut0 = mutator.calls if mutator is not None else 0
+    transport0 = dict(svc.metrics().get("transport") or {})
     sent = 0
     errors = 0
+    sheds = 0
     err_lock = threading.Lock()
+    issue_to = client if client is not None else svc
 
     def _issue(req):
-        nonlocal errors
+        nonlocal errors, sheds
         try:
-            svc.search(queries[req.query_id % len(queries)], k=req.k,
-                       nprobe=req.nprobe)
+            issue_to.search(queries[req.query_id % len(queries)], k=req.k,
+                            nprobe=req.nprobe)
+        except DeadlineExceeded:
+            # an admission shed is an availability decision the trial
+            # reports separately, not a server error
+            with err_lock:
+                sheds += 1
         except Exception:  # noqa: BLE001 — errors are a trial METRIC
             with err_lock:
                 errors += 1
@@ -194,6 +217,25 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         rec["partitions"] = m["partitions"]
         rec["replica_shed"] = m.get("replica_shed", 0)
         rec["partition_degraded"] = m.get("partition_degraded", 0)
+    transport1 = m.get("transport")
+    if transport1 or sheds:
+        # over-the-wire block (docs/SERVING.md "Network front end"),
+        # ONLY when the trial actually crossed a transport (or shed):
+        # in-process trial records stay byte-identical to before.
+        # Counter keys are PER-TRIAL deltas against the trial-start
+        # snapshot; topology keys (workers_live) report the end state.
+        blk: Dict = {}
+        for key in ("wire_bytes", "deadline_sheds", "hedge_fires",
+                    "rpcs", "rpc_fallbacks"):
+            new = (transport1 or {}).get(key)
+            if new is not None:
+                blk[key] = new - transport0.get(key, 0)
+        for key in ("workers_live", "workers_registered"):
+            if transport1 and key in transport1:
+                blk[key] = transport1[key]
+        if sheds:
+            blk["client_sheds"] = sheds
+        rec["transport"] = blk
     if schedule_digest is not None:
         rec["schedule_digest"] = schedule_digest
     if mutator is not None:
@@ -232,18 +274,20 @@ def find_qps_at_p99(svc, workload: Workload, queries: Sequence[str],
                     clock: Callable[[], float] = time.monotonic,
                     sleep: Callable[[float], None] = time.sleep,
                     progress: Optional[Callable[[str], None]] = None,
-                    progress_every_s: float = 0.0) -> Dict:
+                    progress_every_s: float = 0.0, client=None) -> Dict:
     """Binary-search offered load for the max sustained QPS meeting the
     p99 target. Doubling phase brackets the cliff, bisection sharpens it;
     `qps_at_p99` is the best ACHIEVED qps among passing trials (what the
-    service demonstrably served, not what was merely offered)."""
+    service demonstrably served, not what was merely offered). With
+    `client` set the issue path crosses the socket (run_trial) so the
+    measured qps@p99 covers the full network path."""
     trials: List[Dict] = []
 
     def _trial(load: float) -> Dict:
         tr = run_trial(svc, workload, load, queries, duration_s=duration_s,
                        warmup_s=warmup_s, workers=workers, mutator=mutator,
                        clock=clock, sleep=sleep, progress=progress,
-                       progress_every_s=progress_every_s)
+                       progress_every_s=progress_every_s, client=client)
         tr["met"] = _meets(tr, p99_target_ms, max_error_rate, sustain_frac)
         trials.append(tr)
         if progress is not None:

@@ -53,6 +53,9 @@ class LockDisciplineRule(Rule):
            "targets mutating un-annotated shared state")
     scope = (f"{PKG_NAME}/infer/serve.py",
              f"{PKG_NAME}/infer/partition.py",
+             f"{PKG_NAME}/infer/transport.py",
+             f"{PKG_NAME}/infer/server.py",
+             f"{PKG_NAME}/infer/partition_host.py",
              f"{PKG_NAME}/utils/telemetry.py",
              f"{PKG_NAME}/updates/append.py", f"{PKG_NAME}/maintenance/")
 

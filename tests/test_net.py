@@ -1,0 +1,676 @@
+"""Over-the-wire partitioned serving (docs/SERVING.md "Network front
+end"): the wire protocol must REJECT malformed streams cleanly (fuzzed
+truncation/garbage/oversize — never a hung connection), over-the-wire
+results must be BYTE-identical to the in-process scatter-gather
+(including under kill-a-worker and torn-response faults, which degrade
+exactly like the in-process shed path), deadline admission must shed at
+the door — an expired request never consumes a micro-batch bucket slot
+(pinned on a fake clock) — and the tail-latency controls (hedged
+fan-out, liveness routing, heartbeat-bounded recovery) are pinned with
+their counters and events."""
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.infer import transport
+from dnn_page_vectors_tpu.infer.transport import (
+    DeadlineExceeded, FrameError, SocketSearchClient)
+
+pytestmark = pytest.mark.net
+
+DIM = 32
+SHARD = 50
+NSHARDS = 6
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a synthetic store + model-free services (no training — the
+# socket layer is exercised by pre-computed vectors and a stub embedder)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def net_store(tmp_path_factory):
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    sdir = str(tmp_path_factory.mktemp("net_store") / "store")
+    rng = np.random.default_rng(0)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    for si in range(NSHARDS):
+        v = rng.standard_normal((SHARD, DIM)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(si * SHARD, (si + 1) * SHARD,
+                                        dtype=np.int64), v)
+    return VectorStore(sdir)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _qv(n=3, seed=1):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((n, DIM)).astype(np.float32)
+    return q / np.linalg.norm(q, axis=1, keepdims=True)
+
+
+def _fake_embed(queries):
+    """Deterministic text -> unit vector (no model): the socket text
+    path is exercised without a trained encoder."""
+    out = np.zeros((len(queries), DIM), np.float32)
+    for i, q in enumerate(queries):
+        r = np.random.default_rng(
+            np.frombuffer(q.encode()[:8].ljust(8, b"\0"),
+                          np.uint64)[0] % (2 ** 32))
+        v = r.standard_normal(DIM).astype(np.float32)
+        out[i] = v / np.linalg.norm(v)
+    return out
+
+
+class _StubCorpus:
+    def page_text(self, i):
+        return f"page {i}"
+
+
+def _service(net_store, mesh, **serve_over):
+    import dataclasses
+
+    from dnn_page_vectors_tpu.infer.partition_host import MeshEmbedder
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM})
+    if serve_over:
+        cfg = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    **serve_over))
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, net_store,
+                        preload_hbm_gb=4.0)
+    svc._embed_queries_cached = _fake_embed
+    svc.corpus = _StubCorpus()
+    return svc
+
+
+def _thread_worker(cfg, store_dir, port, partition, partitions, replica,
+                   mesh):
+    from dnn_page_vectors_tpu.infer.partition_host import PartitionWorker
+    w = PartitionWorker(cfg, store_dir, ("127.0.0.1", port),
+                        partition=partition, partitions=partitions,
+                        replica=replica, mesh=mesh)
+    t = threading.Thread(target=w.run, daemon=True)
+    t.start()
+    return w, t
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: round trips + fuzz (truncation / garbage / oversize)
+# ---------------------------------------------------------------------------
+
+def test_frame_codec_roundtrip():
+    p = transport.encode_query(7, ["hello", "wörld"], k=10, nprobe=4,
+                               deadline_ms=25.5)
+    r = transport.decode_query(p)
+    assert (r.req_id, r.k, r.nprobe) == (7, 10, 4)
+    assert r.queries == ("hello", "wörld")
+    assert abs(r.deadline_ms - 25.5) < 1e-9
+    qv = _qv(3)
+    v = transport.decode_vquery(transport.encode_vquery(9, qv, k=5,
+                                                        nprobe=2))
+    assert np.array_equal(v.qv, qv) and (v.k, v.nprobe) == (5, 2)
+    scores = _qv(2, seed=3)[:, :5].copy()
+    ids = np.arange(10, dtype=np.int64).reshape(2, 5)
+    rid, s2, i2, scan = transport.decode_result(
+        transport.encode_result(11, scores, ids, scan_bytes=777))
+    assert rid == 11 and scan == 777
+    assert np.array_equal(s2, scores) and np.array_equal(i2, ids)
+    assert transport.decode_shed(transport.encode_shed(
+        3, transport.SHED_DEADLINE, "late")) == (
+            3, transport.SHED_DEADLINE, "late")
+    assert transport.decode_register(
+        transport.encode_register(2, 1, 999)) == (2, 1, 999)
+
+
+def test_frame_fuzz_truncation_garbage_oversize():
+    """Seeded fuzz of the reject paths: every truncation of a valid
+    payload, random garbage, and oversize headers must raise FrameError
+    (or IndexError-free clean decode) — never hang, never crash the
+    decoder with anything else."""
+    rng = np.random.default_rng(42)
+    valid = [
+        transport.encode_query(1, ["abc", "def"], k=3),
+        transport.encode_vquery(2, _qv(2)),
+        transport.encode_result(3, _qv(2)[:, :4].copy(),
+                                np.zeros((2, 4), np.int64)),
+    ]
+    decoders = [transport.decode_query, transport.decode_vquery,
+                transport.decode_result]
+    for payload, decode in zip(valid, decoders):
+        decode(payload)                       # sanity: full payload OK
+        for cut in range(len(payload)):       # EVERY proper prefix rejects
+            with pytest.raises(FrameError):
+                decode(payload[:cut])
+        # trailing garbage is a framing violation too
+        with pytest.raises(FrameError):
+            decode(payload + b"\x00")
+        # random byte flips may still decode (flipping a float is legal)
+        # but must never raise anything but FrameError
+        for _ in range(50):
+            mutated = bytearray(payload)
+            pos = int(rng.integers(0, len(mutated)))
+            mutated[pos] = int(rng.integers(0, 256))
+            try:
+                decode(bytes(mutated))
+            except FrameError:
+                pass
+    # header checks: bad magic, unknown type, oversize length
+    with pytest.raises(FrameError):
+        transport._check_header(struct.pack("!IBI", 0xDEADBEEF, 1, 4))
+    with pytest.raises(FrameError):
+        transport._check_header(struct.pack("!IBI", transport.MAGIC,
+                                            200, 4))
+    with pytest.raises(FrameError):
+        transport._check_header(struct.pack("!IBI", transport.MAGIC, 1,
+                                            transport.MAX_FRAME + 1))
+
+
+def test_read_frame_truncation_vs_clean_eof():
+    """Socket-level framing: clean EOF at a boundary -> None; EOF inside
+    a header or payload -> FrameError (a torn peer, not a clean bye)."""
+    a, b = socket.socketpair()
+    try:
+        b.sendall(transport.pack_frame(transport.T_HEARTBEAT))
+        assert transport.read_frame(a) == (transport.T_HEARTBEAT, b"")
+        b.close()
+        assert transport.read_frame(a) is None        # clean EOF
+    finally:
+        a.close()
+    a, b = socket.socketpair()
+    try:
+        frame = transport.pack_frame(transport.T_QUERY,
+                                     transport.encode_query(1, ["x"]))
+        b.sendall(frame[: len(frame) - 3])            # torn mid-payload
+        b.close()
+        with pytest.raises(FrameError):
+            transport.read_frame(a)
+    finally:
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# the asyncio front end
+# ---------------------------------------------------------------------------
+
+def test_server_results_match_inprocess_and_rejects_garbage(net_store,
+                                                            mesh):
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    svc = _service(net_store, mesh, partitions=2)
+    srv = serve_in_background(svc)
+    client = SocketSearchClient(srv.host, srv.port)
+    try:
+        qv = _qv(3)
+        base_s, base_i = svc.topk_vectors(qv, k=10)
+        s, i, _ = client.topk_vectors(qv, k=10)
+        assert np.array_equal(s, base_s) and np.array_equal(i, base_i)
+        # text path: wire scores/ids == the formatted local results
+        queries = ["alpha", "beta"]
+        local = svc.search_many(queries, k=10)
+        ws, wi, _ = client.search_raw(queries, k=10)
+        for qi, res in enumerate(local):
+            assert [r["page_id"] for r in res] == \
+                [int(x) for x in wi[qi] if x >= 0]
+            assert [r["score"] for r in res] == \
+                [round(float(x), 4) for x, pid in zip(ws[qi], wi[qi])
+                 if pid >= 0]
+        assert svc.wire_bytes > 0
+        # garbage header -> ERROR frame + close, never a hang; the
+        # server keeps serving fresh connections afterwards
+        raw = socket.create_connection((srv.host, srv.port), timeout=5)
+        raw.sendall(b"GET / HTTP/1.1\r\n\r\n")
+        raw.settimeout(5)
+        frame = transport.read_frame(raw)
+        assert frame is not None and frame[0] == transport.T_ERROR
+        assert transport.read_frame(raw) is None      # closed cleanly
+        raw.close()
+        # truncated frame (header promises more than arrives) -> closed
+        raw = socket.create_connection((srv.host, srv.port), timeout=5)
+        raw.sendall(transport.HEADER.pack(transport.MAGIC,
+                                          transport.T_QUERY, 100))
+        raw.sendall(b"short")
+        raw.close()
+        s2, i2, _ = client.topk_vectors(qv, k=10)     # still serving
+        assert np.array_equal(i2, base_i)
+    finally:
+        client.close()
+        srv.close()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware admission (the fake-clock pins)
+# ---------------------------------------------------------------------------
+
+def test_expired_deadline_never_consumes_bucket_slot(net_store, mesh):
+    """THE acceptance pin: a request whose deadline already expired at
+    admission is shed before it can touch the micro-batcher — no queue
+    entry, no bucket slot, counted in serve.deadline_shed (never
+    serve.errors), with the deadline_shed event emitted."""
+    svc = _service(net_store, mesh)
+    fake = {"t": 100.0}
+    svc._clock = lambda: fake["t"]
+    svc.start_batcher()
+    b = svc._batcher
+    try:
+        deadline = svc.default_deadline(5.0)     # anchored at t=100
+        fake["t"] += 1.0                         # ... and long expired
+        n_batches = len(b.batch_sizes)
+        with pytest.raises(DeadlineExceeded):
+            svc.search("gamma", k=10, deadline=deadline)
+        assert len(b.batch_sizes) == n_batches   # no bucket slot
+        assert b._q.qsize() == 0                 # never entered the queue
+        assert svc.deadline_sheds == 1
+        assert svc._m_errors.value == 0          # a shed is not an error
+        evs = [e for e in svc.registry.events()
+               if e["event"] == "deadline_shed"]
+        assert evs and evs[-1]["attrs"]["reason"] == "expired"
+        # no-deadline requests always admit
+        assert svc.search("hello", k=10)
+    finally:
+        svc.close()
+
+
+def test_door_shed_when_deadline_expires_in_queue(net_store, mesh):
+    """A request that admits but expires while queued is shed at the
+    micro-batch DOOR: its future carries DeadlineExceeded and the batch
+    it would have ridden never counts it as a slot."""
+    from concurrent.futures import Future
+    svc = _service(net_store, mesh)
+    fake = {"t": 50.0}
+    svc._clock = lambda: fake["t"]
+    svc.start_batcher()
+    b = svc._batcher
+    try:
+        fut: Future = Future()
+        item = ("q", (10, None), fut, 0.0, None, svc.default_deadline(5.0))
+        fake["t"] += 1.0                         # expires in the queue
+        n_batches = len(b.batch_sizes)
+        b._dispatch([item])
+        assert len(b.batch_sizes) == n_batches   # the shed freed the slot
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=5)
+        evs = [e for e in svc.registry.events()
+               if e["event"] == "deadline_shed"]
+        assert evs[-1]["attrs"]["reason"] == "expired_in_queue"
+        # a mixed batch shed only the expired request; the live one
+        # still dispatched and answered
+        dead: Future = Future()
+        live: Future = Future()
+        b._dispatch([
+            ("d", (10, None), dead, 0.0, None, fake["t"] - 0.001),
+            ("l", (10, None), live, 0.0, None, None)])
+        assert live.result(timeout=30)
+        with pytest.raises(DeadlineExceeded):
+            dead.result(timeout=5)
+        assert b.batch_sizes[-1] == 1            # the shed freed its slot
+    finally:
+        svc.close()
+
+
+def test_slo_budget_shed_from_queue_wait_p99(net_store, mesh):
+    """Admission rung 2: when the windowed queue-wait p99 exceeds the
+    remaining budget, the request cannot make its deadline — shed at the
+    door (reason slo_budget) instead of timing out in a bucket."""
+    svc = _service(net_store, mesh)
+    svc.start_batcher()
+    try:
+        for _ in range(8):
+            svc._m_queue_wait.observe(500.0)
+        with pytest.raises(DeadlineExceeded):
+            svc.search("q", k=10, deadline_ms=10.0)
+        evs = [e for e in svc.registry.events()
+               if e["event"] == "deadline_shed"]
+        assert evs[-1]["attrs"]["reason"] == "slo_budget"
+        assert evs[-1]["attrs"]["queue_wait_p99_ms"] >= 10.0
+        # a budget ABOVE the p99 admits
+        assert svc.search("q", k=10, deadline_ms=5000.0)
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# worker gateway: fan-out identity, liveness, faults, hedging
+# ---------------------------------------------------------------------------
+
+def test_gateway_fanout_byte_identical_and_transport_metrics(net_store,
+                                                             mesh):
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=2)
+    qv = _qv(3)
+    base_s, base_i = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.2)
+    svc.attach_gateway(gw)
+    workers = []
+    try:
+        for p in range(2):
+            workers.append(_thread_worker(svc.cfg, net_store.directory,
+                                          gw.port, p, 2, 0, mesh))
+        assert gw.wait_for_workers(2, timeout_s=30.0)
+        s, i = svc.topk_vectors(qv, k=10)
+        assert np.array_equal(s, base_s) and np.array_equal(i, base_i)
+        st = gw.stats()
+        assert st["rpcs"] >= 2 and st["rpc_fallbacks"] == 0
+        assert st["workers_live"] == 2
+        met = svc.metrics()
+        assert met["transport"]["wire_bytes"] > 0
+        assert met["transport"]["workers_live"] == 2
+        evs = [e["event"] for e in svc.registry.events()]
+        assert evs.count("worker_registered") == 2
+        # the registered events carry the topology
+        reg = [e for e in svc.registry.events()
+               if e["event"] == "worker_registered"]
+        assert sorted((e["attrs"]["partition"], e["attrs"]["replica"])
+                      for e in reg) == [(0, 0), (1, 0)]
+    finally:
+        for w, _ in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+def test_kill_worker_mid_trial_zero_mixed_results(net_store, mesh):
+    """The kill-a-worker drill: a continuous query hammer sees ZERO
+    errors, zero empty and zero non-identical result sets while a
+    partition worker dies abruptly mid-trial; the gateway notices within
+    one heartbeat interval and routing sheds the dead replica with
+    reason "liveness" (R=2), with the worker_lost event emitted."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=1, replicas=2)
+    qv = _qv(2)
+    base_s, base_i = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    workers = []
+    errors, mismatches, results = [], [], [0]
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                s, i = svc.topk_vectors(qv, k=10)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            results[0] += 1
+            if i.size == 0 or not np.array_equal(i, base_i):
+                mismatches.append(i)
+
+    try:
+        for r in range(2):
+            workers.append(_thread_worker(svc.cfg, net_store.directory,
+                                          gw.port, 0, 1, r, mesh))
+        assert gw.wait_for_workers(2, timeout_s=30.0)
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        workers[0][0].stop()                  # kill the primary's worker
+        t_kill = time.perf_counter()
+        while gw.worker_alive(0, 0) and \
+                time.perf_counter() - t_kill < 2.0:
+            time.sleep(0.005)
+        detect_s = time.perf_counter() - t_kill
+        time.sleep(0.4)                       # hammer through the loss
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:2]
+        assert not mismatches, "mixed/empty result set after worker kill"
+        assert results[0] > 0
+        assert detect_s <= gw.heartbeat_s, \
+            f"loss detection took {detect_s:.3f}s (> one heartbeat)"
+        assert any(e["event"] == "worker_lost"
+                   for e in svc.registry.events())
+        # post-kill traffic sheds the dead-worker replica by liveness
+        svc.topk_vectors(qv, k=10)
+        sheds = [e for e in svc.registry.events()
+                 if e["event"] == "replica_shed"]
+        assert sheds and sheds[-1]["attrs"]["reason"] == "liveness"
+    finally:
+        stop.set()
+        for w, _ in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+def test_torn_response_degrades_like_inprocess_shed(net_store, mesh):
+    """A worker that answers with a TORN frame is marked lost (the
+    worker_lost event carries the torn-frame reason) and its in-flight
+    request falls back to the local view — results stay byte-identical;
+    the connection never wedges the gateway."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=1)
+    qv = _qv(2)
+    base_s, base_i = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.25, rpc_timeout_s=5.0)
+    svc.attach_gateway(gw)
+    evil_done = threading.Event()
+
+    def evil_worker():
+        sock = socket.create_connection(("127.0.0.1", gw.port))
+        transport.write_frame(sock, transport.T_REGISTER,
+                              transport.encode_register(0, 0, 4242))
+        frame = transport.read_frame(sock)       # the VQUERY arrives ...
+        assert frame is not None
+        # ... and the reply is a RESULT header promising bytes that
+        # never come: a torn response
+        sock.sendall(transport.HEADER.pack(transport.MAGIC,
+                                           transport.T_RESULT, 4096))
+        sock.sendall(b"\x00" * 16)
+        sock.close()
+        evil_done.set()
+
+    t = threading.Thread(target=evil_worker, daemon=True)
+    t.start()
+    try:
+        assert gw.wait_for_workers(1, timeout_s=30.0)
+        s, i = svc.topk_vectors(qv, k=10)        # torn -> local fallback
+        assert np.array_equal(s, base_s) and np.array_equal(i, base_i)
+        assert evil_done.wait(5.0)
+        t.join(timeout=5.0)
+        lost = [e for e in svc.registry.events()
+                if e["event"] == "worker_lost"]
+        assert lost and "torn" in lost[-1]["attrs"]["reason"]
+        assert gw.stats()["rpc_fallbacks"] >= 1
+        # the gateway keeps serving (now wholly local)
+        s2, i2 = svc.topk_vectors(qv, k=10)
+        assert np.array_equal(i2, base_i)
+    finally:
+        gw.close()
+        svc.close()
+
+
+def test_hedge_fires_to_sibling_after_quantile(net_store, mesh):
+    """Hedged fan-out: once the latency history is warm, a primary that
+    turns slow trips a hedge to the sibling at the quantile point — the
+    fast answer wins, results stay identical, serve.hedge_fired moves,
+    and the hedge_fired event carries the topology."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=1, replicas=2,
+                   hedge_quantile=0.5)
+    qv = _qv(2)
+    base_s, base_i = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    workers = []
+    try:
+        for r in range(2):
+            workers.append(_thread_worker(svc.cfg, net_store.directory,
+                                          gw.port, 0, 1, r, mesh))
+        assert gw.wait_for_workers(2, timeout_s=30.0)
+        for _ in range(10):                   # warm the latency history
+            s, i = svc.topk_vectors(qv, k=10)
+            assert np.array_equal(i, base_i)
+        assert svc.hedge_fires == 0
+        assert gw._hedge_delay_s(0) is not None
+        workers[0][0].slow_ms = 300.0         # the primary turns slow
+        t0 = time.perf_counter()
+        s, i = svc.topk_vectors(qv, k=10)
+        dt = time.perf_counter() - t0
+        assert np.array_equal(s, base_s) and np.array_equal(i, base_i)
+        assert svc.hedge_fires == 1
+        assert dt < 0.28, f"hedge did not save the call ({dt * 1e3:.0f} ms)"
+        evs = [e for e in svc.registry.events()
+               if e["event"] == "hedge_fired"]
+        assert evs and evs[-1]["attrs"]["partition"] == 0
+        assert evs[-1]["attrs"]["to_replica"] == 1
+        assert svc.metrics()["transport"]["hedge_fires"] == 1
+    finally:
+        for w, _ in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+def test_cli_partition_worker_subprocess(net_store, mesh):
+    """The production shape: `cli partition-worker` as a REAL process —
+    registers over the socket, serves its slice byte-identically, and a
+    kill -9 is detected as worker_lost with local-fallback continuity."""
+    import os
+    import subprocess
+    import sys
+
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=2)
+    qv = _qv(2)
+    base_s, base_i = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.3)
+    svc.attach_gateway(gw)
+    workdir = os.path.dirname(net_store.directory)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    try:
+        for p in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "dnn_page_vectors_tpu.cli",
+                 "partition-worker", "--config", "cdssm_toy",
+                 "--workdir", workdir, "--set", f"model.out_dim={DIM}",
+                 "--connect", f"127.0.0.1:{gw.port}",
+                 "--partition", str(p), "--partitions", "2"],
+                cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                stdout=subprocess.PIPE, text=True))
+        assert gw.wait_for_workers(2, timeout_s=120.0), \
+            "partition-worker subprocesses never registered"
+        ready = json.loads(procs[0].stdout.readline())
+        assert ready["partition_worker"] == 0 and ready["partitions"] == 2
+        s, i = svc.topk_vectors(qv, k=10)
+        assert np.array_equal(s, base_s) and np.array_equal(i, base_i)
+        assert gw.stats()["rpc_fallbacks"] == 0
+        procs[0].kill()                       # a real SIGKILL
+        t_kill = time.perf_counter()
+        while gw.worker_alive(0, 0) and \
+                time.perf_counter() - t_kill < 3.0:
+            time.sleep(0.01)
+        assert not gw.worker_alive(0, 0)
+        s, i = svc.topk_vectors(qv, k=10)     # continuity via fallback
+        assert np.array_equal(i, base_i)
+        assert any(e["event"] == "worker_lost"
+                   for e in svc.registry.events())
+    finally:
+        for pr in procs:
+            pr.kill()
+            pr.wait(timeout=10)
+        gw.close()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# loadgen over the wire + report-shape stability
+# ---------------------------------------------------------------------------
+
+def test_run_trial_over_socket_carries_transport_block(net_store, mesh):
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    from dnn_page_vectors_tpu.loadgen import (
+        make_workload, run_trial, snapshot_line)
+    svc = _service(net_store, mesh)
+    svc.start_batcher()
+    srv = serve_in_background(svc)
+    client = SocketSearchClient(srv.host, srv.port)
+    queries = [f"query {i}" for i in range(8)]
+    wl = make_workload("poisson", seed=3, distinct=8)
+    try:
+        tr = run_trial(svc, wl, 50.0, queries, duration_s=0.6,
+                       warmup_s=0.2, workers=8, client=client)
+        assert tr["errors"] == 0 and tr["requests_sent"] > 0
+        assert tr["transport"]["wire_bytes"] > 0
+        line = json.loads(snapshot_line(svc))
+        assert line["wire_bytes"] > 0
+    finally:
+        client.close()
+        srv.close()
+        svc.close()
+
+
+def test_span_tree_starts_at_socket_and_crosses_rpc_hop(net_store, mesh):
+    """Tracing through the transport (docs/OBSERVABILITY.md): a request
+    arriving over the wire records ONE span tree rooted at the socket,
+    with the executor hand-off, the scatter, and the per-partition RPC
+    spans nested under it."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    svc = _service(net_store, mesh, partitions=2)
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    workers = []
+    srv = serve_in_background(svc)
+    client = SocketSearchClient(srv.host, srv.port)
+    try:
+        for p in range(2):
+            workers.append(_thread_worker(svc.cfg, net_store.directory,
+                                          gw.port, p, 2, 0, mesh))
+        assert gw.wait_for_workers(2, timeout_s=30.0)
+        client.topk_vectors(_qv(2), k=10)
+        trace = svc.tracer.last_trace()
+        assert trace["name"] == "socket"
+        assert trace["attrs"]["protocol"] == "vquery"
+
+        def names(d):
+            out = [d["name"]]
+            for c in d["children"]:
+                out.extend(names(c))
+            return out
+
+        got = names(trace)
+        assert "scatter" in got and "merge" in got
+        assert got.count("rpc") == 2          # one RPC hop per partition
+    finally:
+        client.close()
+        srv.close()
+        for w, _ in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+def test_inprocess_records_stay_byte_stable(net_store, mesh):
+    """The satellite pin: without a transport, metrics(), trial records,
+    and snapshot lines carry NO transport block — their shape is
+    byte-identical to the pre-transport format."""
+    from dnn_page_vectors_tpu.loadgen import (
+        make_workload, run_trial, snapshot_line)
+    svc = _service(net_store, mesh)
+    try:
+        assert "transport" not in svc.metrics()
+        wl = make_workload("poisson", seed=1, distinct=4)
+        tr = run_trial(svc, wl, 30.0, ["a", "b", "c", "d"],
+                       duration_s=0.3, warmup_s=0.0, workers=2)
+        assert "transport" not in tr
+        line = json.loads(snapshot_line(svc))
+        for key in ("wire_bytes", "deadline_sheds", "hedge_fires",
+                    "workers_live"):
+            assert key not in line
+    finally:
+        svc.close()

@@ -218,6 +218,70 @@ def test_partitioned_pq_adc_and_exact_fallback_mixed(served, tmp_path):
     exact.close()
 
 
+def test_over_the_wire_tombstones_and_pq_mixed_identical(served, tmp_path):
+    """The PR-12 byte-identity pin extended over the socket
+    (docs/SERVING.md "Network front end"): with tombstoned rows AND a
+    full-probe PQ/ADC index, results through real partition-worker
+    sockets — including one partition degraded to the exact fallback
+    and one answering from the front end's LOCAL view after its worker
+    dies — stay byte-identical to the single-partition exact path."""
+    import threading
+
+    from dnn_page_vectors_tpu.index.ivf import IVFIndex
+    from dnn_page_vectors_tpu.infer.partition_host import (
+        PartitionWorker, WorkerGateway)
+    from dnn_page_vectors_tpu.updates import append_corpus
+    cfg, trainer, emb, _ = served
+    store = _fresh_store(served, tmp_path)
+    dead = [3, 42, 123]
+    append_corpus(emb, trainer.corpus, store, tombstone=dead)
+    store = VectorStore(store.directory)
+    IVFIndex.build(store, emb.mesh, seed=0, pq_m=6)
+    exact = SearchService(_cfg(), emb, trainer.corpus, store,
+                          preload_hbm_gb=4.0)
+    queries = [trainer.corpus.query_text(qi)
+               for qi in (3, 42, 123, 0, 7, 200)]
+    base = exact.search_many(queries, k=10)
+    svc = SearchService(
+        _cfg(partitions=2, index="ivf", nprobe=10_000, pq_rerank=300),
+        emb, trainer.corpus, store, preload_hbm_gb=4.0)
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    workers = []
+    try:
+        for p in range(2):
+            w = PartitionWorker(svc.cfg, store.directory,
+                                ("127.0.0.1", gw.port), partition=p,
+                                partitions=2, replica=0, mesh=emb.mesh)
+            threading.Thread(target=w.run, daemon=True).start()
+            workers.append(w)
+        assert gw.wait_for_workers(2, timeout_s=60.0)
+        res = svc.search_many(queries, k=10)
+        assert res == base
+        assert gw.stats()["rpc_fallbacks"] == 0
+        for r in res:
+            assert not set(x["page_id"] for x in r) & set(dead)
+        # partition 1's WORKER degrades to the exact fallback (its index
+        # dropped) while partition 0 stays on ADC over the wire — mixed
+        # retrieval modes across the RPC hop, still identical
+        workers[1].view.index = None
+        assert svc.search_many(queries, k=10) == base
+        # kill partition 0's worker: its slice folds from the front
+        # end's local view — identical again, kill -9 semantics
+        workers[0].stop()
+        deadline = time.perf_counter() + 2.0
+        while gw.worker_alive(0, 0) and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert svc.search_many(queries, k=10) == base
+        assert gw.stats()["rpc_fallbacks"] >= 0
+    finally:
+        for w in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+        exact.close()
+
+
 # ---------------------------------------------------------------------------
 # health-based replica routing
 # ---------------------------------------------------------------------------
