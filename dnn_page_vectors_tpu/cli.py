@@ -817,7 +817,8 @@ def main(argv=None) -> None:
                         "expected": P * R}), file=sys.stderr, flush=True)
             net_server = serve_in_background(svc)
             client = SocketSearchClient(net_server.host, net_server.port,
-                                        deadline_ms=cfg.serve.deadline_ms)
+                                        deadline_ms=cfg.serve.deadline_ms,
+                                        compress=cfg.serve.wire_compress)
         distinct = max(1, args.distinct)
         queries = [trainer.corpus.query_text(i) for i in range(distinct)]
         wl = make_workload(args.shape, seed=args.seed, distinct=distinct,

@@ -292,6 +292,15 @@ class ServeConfig:
     # (reason "liveness") and the fan-out serves its slice from the
     # front end's local view until it re-registers.
     heartbeat_s: float = 0.5
+    # Wire compression (docs/SERVING.md "Network front end"): negotiated
+    # per connection (REGISTER flags / T_HELLO), LOSSLESS — RESULT
+    # frames ship raw f32 scores + zigzag-delta varint page ids, and
+    # repeated query blocks intern into per-connection slots (sent once,
+    # then a 2-byte reference), so socket results stay byte-identical to
+    # in-process while wire bytes/query drop >= 2.5x on repeat-heavy
+    # traffic. False = every connection negotiates down to raw frames
+    # (the PR-13 wire format); mixed fleets interoperate either way.
+    wire_compress: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

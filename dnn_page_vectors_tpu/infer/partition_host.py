@@ -63,8 +63,10 @@ import numpy as np
 
 from dnn_page_vectors_tpu.infer import transport
 from dnn_page_vectors_tpu.infer.transport import (
-    DeadlineExceeded, FrameError, RemoteError, T_BYE, T_HEARTBEAT,
-    T_REGISTER, T_RESULT, T_SHED, T_ERROR, T_VQUERY)
+    DeadlineExceeded, FrameError, FLAG_WIRE_COMPRESS, FrameSender,
+    InternTable, RemoteError, T_BYE, T_HEARTBEAT, T_HELLO, T_REFRESH,
+    T_REGISTER, T_RESULT, T_RESULT_C, T_SHED, T_ERROR, T_VQUERY,
+    T_VQUERY_PUT, T_VQUERY_REF)
 from dnn_page_vectors_tpu.ops.topk import merge_partition_topk
 from dnn_page_vectors_tpu.utils.profiling import LatencyStats
 
@@ -84,17 +86,24 @@ class _WorkerConn:
     """Front-end-side record of one registered partition worker."""
 
     def __init__(self, sock: socket.socket, addr, partition: int,
-                 replica: int, pid: int):
+                 replica: int, pid: int, flags: int = 0,
+                 generation: int = 0):
         self.sock = sock
         self.addr = addr
         self.partition = int(partition)
         self.replica = int(replica)
         self.pid = int(pid)
+        self.flags = int(flags)            # negotiated caps, set once
         self.wlock = threading.Lock()      # serializes frame writes
+        # send-path state shared with the writer: the reused encode
+        # buffer and the query-block intern ring both live under wlock
+        self.sender = FrameSender(sock)    # guarded-by: wlock
+        self.intern = InternTable()        # guarded-by: wlock
         self._lock = threading.Lock()
         self._last_beat = time.perf_counter()   # guarded-by: _lock
         self._dead = False                       # guarded-by: _lock
         self._lost_reason: Optional[str] = None  # guarded-by: _lock
+        self._generation = int(generation)       # guarded-by: _lock
 
     def beat(self) -> None:
         with self._lock:
@@ -121,6 +130,15 @@ class _WorkerConn:
         with self._lock:
             return self._dead
 
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def set_generation(self, gen: int) -> None:
+        with self._lock:
+            self._generation = int(gen)
+
 
 class WorkerGateway:
     """The front end's worker registry + RPC fan-out (one per service).
@@ -143,6 +161,11 @@ class WorkerGateway:
             hedge_quantile if hedge_quantile is not None
             else getattr(serve_cfg, "hedge_quantile", 0.95)
             if serve_cfg is not None else 0.95)
+        # serve.wire_compress: what THIS end confirms when a worker
+        # advertises compression at REGISTER; off = the whole fleet
+        # talks raw frames regardless of worker capability
+        self._compress = bool(getattr(serve_cfg, "wire_compress", True)
+                              if serve_cfg is not None else True)
         self.rpc_timeout_s = float(rpc_timeout_s)
         self._own_pset = None
         if pset is None:
@@ -205,33 +228,59 @@ class WorkerGateway:
             if frame is None or frame[0] != T_REGISTER:
                 conn.close()
                 return
-            svc._m_wire_bytes.inc(transport.HEADER.size + len(frame[1]))
-            pid_, rid, wpid = transport.decode_register(frame[1])
-            worker = _WorkerConn(conn, addr, pid_, rid, wpid)
+            self._account(transport.HEADER.size + len(frame[1]))
+            pid_, rid, wpid, wflags, wgen = transport.decode_register(
+                frame[1])
+            agreed = wflags & (FLAG_WIRE_COMPRESS if self._compress else 0)
+            worker = _WorkerConn(conn, addr, pid_, rid, wpid,
+                                 flags=agreed, generation=wgen)
             with self._lock:
                 old = self._workers.get((pid_, rid))
                 self._workers[(pid_, rid)] = worker
                 self._registered += 1
             if old is not None and old.mark_dead("replaced"):
                 self._fail_inflight(old, "replaced by a new registration")
+            if wflags:
+                # confirm the negotiated capability set on the same
+                # ordered stream — the ack lands before any VQUERY, so
+                # the worker knows the agreed flags by its first answer
+                with worker.wlock:
+                    worker.sender.send(T_HELLO, transport.encode_hello(
+                        agreed), counter=svc._m_wire_bytes,
+                        raw_counter=svc._m_wire_raw)
             svc.registry.event("worker_registered", {
                 "partition": pid_, "replica": rid, "pid": wpid,
-                "addr": f"{addr[0]}:{addr[1]}"})
+                "addr": f"{addr[0]}:{addr[1]}",
+                "wire_compress": bool(agreed & FLAG_WIRE_COMPRESS),
+                "generation": wgen})
             while True:
                 frame = transport.read_frame(conn)
                 if frame is None:
                     break
                 ftype, payload = frame
-                svc._m_wire_bytes.inc(transport.HEADER.size + len(payload))
+                actual = transport.HEADER.size + len(payload)
                 if ftype == T_HEARTBEAT:
+                    self._account(actual)
                     worker.beat()
-                elif ftype in (T_RESULT, T_SHED, T_ERROR):
+                elif ftype in (T_RESULT, T_RESULT_C, T_SHED, T_ERROR):
                     worker.beat()     # any traffic proves liveness
-                    self._resolve(ftype, payload)
+                    self._resolve(ftype, payload, actual)
+                elif ftype == T_REFRESH:
+                    # the worker's view-rebuild ack: it now serves this
+                    # store generation and is routable again
+                    self._account(actual)
+                    gen = transport.decode_refresh(payload)
+                    worker.set_generation(gen)
+                    worker.beat()
+                    svc.registry.event("worker_refreshed", {
+                        "partition": worker.partition,
+                        "replica": worker.replica, "generation": gen})
                 elif ftype == T_BYE:
+                    self._account(actual)
                     reason = "deregistered"
                     break
                 else:
+                    self._account(actual)
                     reason = f"unexpected frame type {ftype}"
                     break
         except FrameError as e:
@@ -252,15 +301,28 @@ class WorkerGateway:
                     "replica": worker.replica,
                     "reason": reason[:200]})
 
-    def _resolve(self, ftype: int, payload: bytes) -> None:
-        if ftype == T_RESULT:
-            req_id, scores, ids, scan = transport.decode_result(payload)
+    def _account(self, actual: int, raw: Optional[int] = None) -> None:
+        """Wire-byte accounting: actual bytes moved, plus the raw-frame
+        equivalent (what the same traffic would have cost uncompressed)
+        feeding the wire-compression ratio."""
+        self._svc._m_wire_bytes.inc(actual)
+        self._svc._m_wire_raw.inc(actual if raw is None else raw)
+
+    def _resolve(self, ftype: int, payload: bytes, actual: int) -> None:
+        if ftype in (T_RESULT, T_RESULT_C):
+            req_id, scores, ids, scan = transport.decode_result_any(
+                ftype, payload)
+            self._account(actual,
+                          raw=transport.result_raw_bytes(*scores.shape)
+                          if ftype == T_RESULT_C else actual)
             ok: Optional[Tuple] = (scores, ids, scan)
             exc: Optional[Exception] = None
         elif ftype == T_SHED:
+            self._account(actual)
             req_id, code, why = transport.decode_shed(payload)
             ok, exc = None, DeadlineExceeded(why or f"shed code {code}")
         else:
+            self._account(actual)
             req_id, msg = transport.decode_error(payload)
             ok, exc = None, RemoteError(msg)
         with self._lock:
@@ -319,40 +381,74 @@ class WorkerGateway:
         return len(self.live_workers()) >= n
 
     def _pick_worker(self, pid: int, prefer_rid: int,
-                     exclude: Tuple[int, ...] = ()) -> Optional[_WorkerConn]:
+                     exclude: Tuple[int, ...] = (),
+                     generation: Optional[int] = None
+                     ) -> Optional[_WorkerConn]:
         """The live worker that should answer partition `pid`: the routed
         replica's own worker when live, else the lowest-rid live sibling
-        not in `exclude`."""
+        not in `exclude`. With `generation` set, a worker whose view
+        serves a DIFFERENT store generation is ineligible — after a
+        refresh the fan-out serves that slice locally (on the already-
+        swapped front-end view) until the worker's T_REFRESH ack lands,
+        so one result set can never mix generations across the wire."""
         with self._lock:
             cands = [(rid, w) for (p, rid), w in self._workers.items()
                      if p == pid and rid not in exclude]
         cands.sort(key=lambda t: (t[0] != prefer_rid, t[0]))
         age = self._alive_age_s()
         for _, w in cands:
-            if w.alive(age):
+            if w.alive(age) and (generation is None
+                                 or w.generation == generation):
                 return w
         return None
 
     # -- the RPC fan-out ---------------------------------------------------
-    def _send(self, worker: _WorkerConn, qv: np.ndarray, n: int, k: int,
-              nprobe: Optional[int],
+    def _prepare(self, qv: np.ndarray, n: int) -> Tuple[bytes, int, int]:
+        """The shared fan-out encode: the query block's wire bytes are
+        built ONCE per coalesced bucket and shared across every
+        partition send (and every hedge/failover resend) — each RPC adds
+        only its per-request head. -> (block bytes, n, dim)."""
+        block = np.ascontiguousarray(qv[:n], dtype="<f4")
+        return block.tobytes(), n, block.shape[1]
+
+    def _send(self, worker: _WorkerConn, prep: Tuple[bytes, int, int],
+              k: int, nprobe: Optional[int],
               deadline: Optional[float]) -> Future:
         svc = self._svc
+        block, n, dim = prep
         req_id = transport.next_request_id()
         rem_ms = 0.0
         if deadline is not None:
             rem_ms = max((deadline - svc._clock()) * 1000.0, 0.001)
-        payload = transport.encode_vquery(req_id, qv[:n], k=k,
-                                          nprobe=nprobe or 0,
-                                          deadline_ms=rem_ms)
+        head = transport._VQUERY_HEAD.pack(req_id, rem_ms, int(k),
+                                           int(nprobe or 0), n, dim)
         fut: Future = Future()
         with self._lock:
             self._pending[req_id] = (fut, worker)
             self._rpcs += 1
         try:
             with worker.wlock:
-                transport.write_frame(worker.sock, T_VQUERY, payload,
-                                      counter=svc._m_wire_bytes)
+                if worker.flags & FLAG_WIRE_COMPRESS:
+                    # interned send: the block ships once per connection
+                    # slot; repeats cost a 2-byte reference
+                    slot, fresh = worker.intern.slot_for(block)
+                    slot_b = transport._SLOT.pack(slot)
+                    raw = (transport.HEADER.size + len(head) + len(block))
+                    if fresh:
+                        worker.sender.send(T_VQUERY_PUT, head, slot_b,
+                                           block,
+                                           counter=svc._m_wire_bytes,
+                                           raw_counter=svc._m_wire_raw,
+                                           raw_len=raw)
+                    else:
+                        worker.sender.send(T_VQUERY_REF, head, slot_b,
+                                           counter=svc._m_wire_bytes,
+                                           raw_counter=svc._m_wire_raw,
+                                           raw_len=raw)
+                else:
+                    worker.sender.send(T_VQUERY, head, block,
+                                       counter=svc._m_wire_bytes,
+                                       raw_counter=svc._m_wire_raw)
         except OSError as e:
             with self._lock:
                 self._pending.pop(req_id, None)
@@ -386,9 +482,11 @@ class WorkerGateway:
             lat.add(seconds)
 
     def _await_partition(self, pid: int, prefer_rid: int, first: Future,
-                         first_rid: int, qv: np.ndarray, n: int, k: int,
-                         nprobe: Optional[int],
-                         deadline: Optional[float]) -> Optional[Tuple]:
+                         first_rid: int, prep: Tuple[bytes, int, int],
+                         k: int, nprobe: Optional[int],
+                         deadline: Optional[float],
+                         generation: Optional[int] = None
+                         ) -> Optional[Tuple]:
         """Wait for partition `pid`'s RPC answer, hedging to a sibling at
         the latency-quantile point and failing over on worker loss; None
         when every wire route failed (the caller serves locally)."""
@@ -433,10 +531,11 @@ class WorkerGateway:
                 # every issued RPC failed: fail over to an untried live
                 # sibling (not a hedge — the first copy is already dead)
                 w = self._pick_worker(pid, prefer_rid,
-                                      exclude=tuple(tried))
+                                      exclude=tuple(tried),
+                                      generation=generation)
                 if w is None:
                     return None
-                in_flight[self._send(w, qv, n, k, nprobe, deadline)] = \
+                in_flight[self._send(w, prep, k, nprobe, deadline)] = \
                     w.replica
                 tried.add(w.replica)
                 continue
@@ -446,7 +545,8 @@ class WorkerGateway:
                     and elapsed >= hedge_s):
                 hedged = True
                 w = self._pick_worker(pid, prefer_rid,
-                                      exclude=tuple(tried))
+                                      exclude=tuple(tried),
+                                      generation=generation)
                 if w is not None:
                     svc._m_hedge_fired.inc()
                     cur = svc.tracer.current()
@@ -455,7 +555,7 @@ class WorkerGateway:
                         "to_replica": w.replica,
                         "after_ms": round(elapsed * 1000.0, 3),
                     }, trace_id=getattr(cur, "trace_id", None))
-                    in_flight[self._send(w, qv, n, k, nprobe,
+                    in_flight[self._send(w, prep, k, nprobe,
                                          deadline)] = w.replica
                     tried.add(w.replica)
 
@@ -473,16 +573,20 @@ class WorkerGateway:
         pset = self.partition_set
         table = pset._view_table
         P = pset.partitions
+        # ONE shared encode for the whole scatter (and its hedges): the
+        # block bytes build here and every per-partition send reuses them
+        prep = self._prepare(qv, n)
         calls: List[Tuple[int, object, Optional[Future], int]] = []
         with svc._stage("scatter", partitions=P, transport="socket"):
             for pid in range(P):
                 rep = pset._route(pid)
-                w = self._pick_worker(pid, rep.rid)
+                gen = table[pid][rep.rid].generation
+                w = self._pick_worker(pid, rep.rid, generation=gen)
                 if w is None:
                     calls.append((pid, rep, None, -1))
                 else:
                     calls.append((pid, rep,
-                                  self._send(w, qv, n, k, nprobe, deadline),
+                                  self._send(w, prep, k, nprobe, deadline),
                                   w.replica))
             parts: List[Optional[Tuple]] = [None] * P
             for pid, rep, fut, rid in calls:
@@ -490,8 +594,9 @@ class WorkerGateway:
                 if fut is not None:
                     with svc._stage("rpc", partition=pid, replica=rid):
                         res = self._await_partition(
-                            pid, rep.rid, fut, rid, qv, n, k, nprobe,
-                            deadline)
+                            pid, rep.rid, fut, rid, prep, k, nprobe,
+                            deadline,
+                            generation=table[pid][rep.rid].generation)
                 if res is None:
                     # the in-process degrade path, verbatim: this
                     # partition's slice computed on the front end's own
@@ -506,6 +611,66 @@ class WorkerGateway:
         with svc._stage("merge"):
             return merge_partition_topk([(s, i) for s, i, _ in parts])
 
+    # -- store-generation control (docs/SERVING.md) ------------------------
+    def broadcast_refresh(self, generation: int,
+                          wait_s: float = 0.0) -> Dict:
+        """Tell every live worker to re-open the store and rebuild its
+        view (T_REFRESH carrying the target generation) — the wire
+        fleet's half of `SearchService.refresh()`: a store generation
+        swap no longer needs a worker restart. Until a worker ACKS with
+        its own T_REFRESH, routing treats it as generation-stale and the
+        fan-out serves its slice from the front end's local view, so the
+        swap stays byte-consistent while the fleet catches up. With
+        `wait_s` > 0 the call blocks up to that long for every live
+        worker's ack."""
+        svc = self._svc
+        if self._own_pset is not None:
+            # single-view service: the gateway's private 1-partition set
+            # must follow the store too, or its table (and the local
+            # fallback views in it) would serve the old generation
+            # forever while generation gating kept every worker
+            # ineligible
+            self._own_pset.refresh(svc.store)
+        with self._lock:
+            workers = list(self._workers.values())
+        age = self._alive_age_s()
+        told = 0
+        for w in workers:
+            if not w.alive(age) or w.generation == generation:
+                continue
+            try:
+                with w.wlock:
+                    w.sender.send(T_REFRESH,
+                                  transport.encode_refresh(generation),
+                                  counter=svc._m_wire_bytes,
+                                  raw_counter=svc._m_wire_raw)
+                told += 1
+            except OSError:
+                pass              # a dying worker re-registers fresh
+        if wait_s > 0:
+            self.wait_for_generation(generation, timeout_s=wait_s)
+        return {"workers_told": told,
+                "workers_stale": self.stale_workers(generation)}
+
+    def stale_workers(self, generation: int) -> int:
+        """Live workers whose view still serves another generation."""
+        with self._lock:
+            workers = list(self._workers.values())
+        age = self._alive_age_s()
+        return sum(1 for w in workers
+                   if w.alive(age) and w.generation != generation)
+
+    def wait_for_generation(self, generation: int,
+                            timeout_s: float = 30.0) -> bool:
+        """Block until no live worker lags `generation` — the fleet-wide
+        refresh barrier for tests/cli; False on timeout."""
+        t_end = time.perf_counter() + timeout_s
+        while time.perf_counter() < t_end:
+            if self.stale_workers(generation) == 0:
+                return True
+            time.sleep(0.01)
+        return self.stale_workers(generation) == 0
+
     # -- telemetry / lifecycle --------------------------------------------
     def stats(self) -> Dict:
         """The metrics()/loadtest transport sub-block."""
@@ -513,9 +678,13 @@ class WorkerGateway:
             registered = self._registered
             rpcs = self._rpcs
             fallbacks = self._rpc_fallbacks
+            compressing = sum(
+                1 for w in self._workers.values()
+                if not w.dead and w.flags & FLAG_WIRE_COMPRESS)
         return {
             "workers_live": len(self.live_workers()),
             "workers_registered": registered,
+            "workers_compressing": compressing,
             "rpcs": rpcs,
             "rpc_fallbacks": fallbacks,
         }
@@ -530,6 +699,15 @@ class WorkerGateway:
         except OSError:
             pass
         for w in workers:
+            # a clean BYE first: workers exit their serve loop instead of
+            # reading a reset mid-frame (part of the graceful-drain
+            # contract — docs/SERVING.md)
+            if not w.dead:
+                try:
+                    with w.wlock:
+                        w.sender.send(T_BYE)
+                except OSError:
+                    pass
             w.mark_dead("gateway closed")
             try:
                 w.sock.close()
@@ -567,6 +745,11 @@ class PartitionWorker:
         self.connect = (connect[0], int(connect[1]))
         self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
                             else getattr(cfg.serve, "heartbeat_s", 0.5))
+        # wire compression is ADVERTISED at REGISTER and only used after
+        # the gateway confirms (T_HELLO ack) — a raw gateway, or a raw
+        # sibling on the same gateway, interoperates untouched
+        self.wire_compress = bool(getattr(cfg.serve, "wire_compress", True))
+        self._flags = 0           # agreed capabilities (run-loop only)
         # drill hook (tests, the bench hedge drill): added per-request
         # latency, so a deliberately slow replica provokes hedging
         self.slow_ms = float(slow_ms)
@@ -594,13 +777,14 @@ class PartitionWorker:
         self._sock: Optional[socket.socket] = None
         self._wlock = threading.Lock()     # serializes frame writes
         self._stop = threading.Event()
+        self._sender: Optional[FrameSender] = None  # guarded-by: _wlock
 
     # -- lifecycle ---------------------------------------------------------
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_s):
             try:
                 with self._wlock:
-                    transport.write_frame(self._sock, T_HEARTBEAT)
+                    self._sender.send(T_HEARTBEAT)
             except OSError:
                 return
 
@@ -610,20 +794,31 @@ class PartitionWorker:
         sock = socket.create_connection(self.connect)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
+        with self._wlock:
+            self._sender = FrameSender(sock)
         transport.write_frame(sock, T_REGISTER, transport.encode_register(
-            self.partition, self.replica, os.getpid()))
+            self.partition, self.replica, os.getpid(),
+            flags=FLAG_WIRE_COMPRESS if self.wire_compress else 0,
+            generation=self.view.generation))
         hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
                               name=f"worker-p{self.partition}"
                                    f"r{self.replica}-hb")
         hb.start()
+        slots: Dict[int, bytes] = {}   # per-connection intern table
         try:
             while not self._stop.is_set():
                 frame = transport.read_frame(sock)
                 if frame is None:
                     break
                 ftype, payload = frame
-                if ftype == T_VQUERY:
-                    self._answer(payload)
+                if ftype in (T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF):
+                    self._answer(ftype, payload, slots)
+                elif ftype == T_HELLO:
+                    # the gateway's negotiation ack: these capabilities
+                    # are agreed for the rest of the connection
+                    self._flags = transport.decode_hello(payload)
+                elif ftype == T_REFRESH:
+                    self._refresh(transport.decode_refresh(payload))
                 elif ftype == T_BYE:
                     break
                 # anything else from the gateway is ignorable control
@@ -637,10 +832,50 @@ class PartitionWorker:
             except OSError:
                 pass
 
+    def _refresh(self, generation: int) -> None:
+        """The T_REFRESH control path: re-open the store, rebuild this
+        replica's restricted view over the (possibly re-balanced) shard
+        split, swap it in with one reference assignment, and ack with
+        the generation now served — byte-identical to a worker restarted
+        against the same store, with no restart. A rebuild failure keeps
+        the OLD view serving (the gateway routes around the stale
+        generation until a later refresh lands)."""
+        from dnn_page_vectors_tpu.infer.partition import (
+            make_partition_specs)
+        from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+        try:
+            new_store = VectorStore(self.svc.store.directory)
+            specs = make_partition_specs(
+                new_store.shards(), self.partitions,
+                hot_gb=self.svc.cfg.serve.hot_postings_gb)
+            if self.partition < len(specs):
+                spec = specs[self.partition]
+            else:            # the balanced split shrank under this slice
+                from dnn_page_vectors_tpu.infer.partition import (
+                    PartitionSpec)
+                spec = PartitionSpec(pid=self.partition, entries=(),
+                                     shard_indices=(), rows=0, hot_gb=0.0)
+            view = self.svc._build_view(new_store, reuse=self.view,
+                                        entries=list(spec.entries),
+                                        hot_gb=spec.hot_gb)
+            self.spec = spec
+            self.view = view     # THE swap: one reference assignment
+            self.svc.store = new_store
+        except Exception:  # noqa: BLE001 — keep serving the old view
+            pass
+        try:
+            with self._wlock:
+                self._sender.send(T_REFRESH, transport.encode_refresh(
+                    self.view.generation))
+        except OSError:
+            pass
+
     # graftcheck: hot
-    def _answer(self, payload: bytes) -> None:
-        req = transport.decode_vquery(payload)
+    def _answer(self, ftype: int, payload: bytes,
+                slots: Dict[int, bytes]) -> None:
+        req = transport.decode_vquery_any(ftype, payload, slots)
         t0 = time.perf_counter()
+        parts: Tuple
         try:
             if self.slow_ms > 0:
                 time.sleep(self.slow_ms / 1000.0)
@@ -651,21 +886,27 @@ class PartitionWorker:
                     (time.perf_counter() - t0) * 1000.0 > req.deadline_ms:
                 # the budget died during compute: a late answer is waste
                 # on the wire — the gateway already fell back
-                ftype = T_SHED
-                out = transport.encode_shed(
+                rtype = T_SHED
+                parts = (transport.encode_shed(
                     req.req_id, transport.SHED_DEADLINE,
-                    "deadline expired during partition compute")
+                    "deadline expired during partition compute"),)
+            elif self._flags & FLAG_WIRE_COMPRESS:
+                rtype = T_RESULT_C
+                parts = (transport.encode_result_c(req.req_id, scores,
+                                                   ids, scan_bytes=scan),)
             else:
-                ftype = T_RESULT
-                out = transport.encode_result(req.req_id, scores, ids,
-                                              scan_bytes=scan)
+                rtype = T_RESULT
+                scores = np.ascontiguousarray(scores, dtype="<f4")
+                ids = np.ascontiguousarray(ids, dtype="<i8")
+                parts = (transport._RESULT_HEAD.pack(
+                    req.req_id, int(scan), *scores.shape), scores, ids)
         except Exception as e:  # noqa: BLE001 — the request fails, the
             # worker survives: per-request isolation like the batcher's
-            ftype = T_ERROR
-            out = transport.encode_error(req.req_id,
-                                         f"{type(e).__name__}: {e}")
+            rtype = T_ERROR
+            parts = (transport.encode_error(req.req_id,
+                                            f"{type(e).__name__}: {e}"),)
         with self._wlock:
-            transport.write_frame(self._sock, ftype, out)
+            self._sender.send(rtype, *parts)
 
     def stop(self) -> None:
         """Abrupt local shutdown (tests' stand-in for kill -9): close the

@@ -526,9 +526,14 @@ class SearchService:
         self._m_deadline_shed = reg.counter("serve.deadline_shed",
                                             window_s=window_s)
         # hedged fan-out + wire accounting (populated by the worker
-        # gateway / socket front end when transport serving is attached)
+        # gateway / socket front end when transport serving is attached).
+        # wire_raw_bytes is the raw-frame EQUIVALENT of the same traffic
+        # — compressed RESULT frames and interned query blocks count what
+        # they replaced — so raw/actual is the live wire-compression
+        # ratio (serve.wire_compress, docs/SERVING.md)
         self._m_hedge_fired = reg.counter("serve.hedge_fired")
         self._m_wire_bytes = reg.counter("serve.wire_bytes")
+        self._m_wire_raw = reg.counter("serve.wire_raw_bytes")
         # the RPC fan-out (partition_host.WorkerGateway), attached by
         # attach_gateway(); None = the in-process scatter-gather
         self._fanout = None
@@ -719,6 +724,13 @@ class SearchService:
     @property
     def wire_bytes(self) -> int:
         return self._m_wire_bytes.value
+
+    @property
+    def wire_raw_bytes(self) -> int:
+        """Raw-frame equivalent of wire_bytes (the compression ratio's
+        numerator); equals wire_bytes when nothing negotiated
+        compression."""
+        return self._m_wire_raw.value
 
     @property
     def fanout(self):
@@ -921,6 +933,16 @@ class SearchService:
             # per-partition rolling-swap record (docs/SCALING.md): which
             # partition restaged when, and each replica's swap window
             info["partitions"] = part_info
+        if self._fanout is not None:
+            # over-the-wire fleet (docs/SERVING.md "Network front end"):
+            # tell every registered worker to rebuild onto this
+            # generation (T_REFRESH control frame) — no worker restart.
+            # The broadcast does NOT block the refresh: until a worker
+            # acks, routing treats it as generation-stale and its slice
+            # serves from the local view just swapped in above, so
+            # results stay byte-consistent while the fleet catches up
+            info["workers_refresh"] = self._fanout.broadcast_refresh(
+                view.generation)
         # lifecycle event (docs/OBSERVABILITY.md): the hot-swap is the
         # transition dashboards alert on; trace-id correlation ties it to
         # the request that observed it when refresh runs under a trace
@@ -1468,6 +1490,12 @@ class SearchService:
         transport: Dict = {}
         if self.wire_bytes:
             transport["wire_bytes"] = self.wire_bytes
+            if self.wire_raw_bytes > self.wire_bytes:
+                # the wire-compression pair (docs/SERVING.md): what the
+                # same traffic would have cost raw, and the live ratio
+                transport["wire_raw_bytes"] = self.wire_raw_bytes
+                transport["wire_compression_ratio"] = round(
+                    self.wire_raw_bytes / self.wire_bytes, 3)
         if self.deadline_sheds:
             transport["deadline_sheds"] = self.deadline_sheds
         if self.hedge_fires:

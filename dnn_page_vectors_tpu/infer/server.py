@@ -41,8 +41,9 @@ import numpy as np
 
 from dnn_page_vectors_tpu.infer import transport
 from dnn_page_vectors_tpu.infer.transport import (
-    DeadlineExceeded, FrameError, T_QUERY, T_RESULT, T_SHED, T_ERROR,
-    T_VQUERY)
+    DeadlineExceeded, FrameError, FLAG_WIRE_COMPRESS, T_HELLO, T_QUERY,
+    T_RESULT, T_RESULT_C, T_SHED, T_ERROR, T_VQUERY, T_VQUERY_PUT,
+    T_VQUERY_REF)
 
 
 def parse_listen(listen: str) -> Tuple[str, int]:
@@ -79,12 +80,21 @@ class SearchServer:
         self.svc = svc
         self.host = host if host is not None else cfg_host
         self.port = port if port is not None else cfg_port
+        # serve.wire_compress gates what this end ADVERTISES: with it off
+        # every connection negotiates down to the raw frames
+        self._compress = bool(getattr(serve_cfg, "wire_compress", True)
+                              if serve_cfg is not None else True)
         self._executor = ThreadPoolExecutor(
             max_workers=executor_workers,
             thread_name_prefix="serve-socket")
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
+        # graceful-drain state, touched only on the event loop: close()
+        # flips _draining, in-flight dispatches finish, fresh requests
+        # shed with reason "draining" instead of dying mid-frame
+        self._draining = False
+        self._inflight = 0
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> "SearchServer":
@@ -130,16 +140,28 @@ class SearchServer:
             raise failed[0]
         return self
 
-    def close(self) -> None:
+    def close(self, drain_s: float = 5.0) -> None:
+        """Graceful shutdown: stop accepting, DRAIN in-flight requests —
+        dispatches already on the executor finish and answer normally,
+        fresh frames arriving on open connections shed with reason
+        "draining" — then cancel the idle per-connection readers. A
+        close never drops a socket mid-frame on a request the service
+        already accepted; `drain_s` bounds how long a slow in-flight
+        dispatch can hold the shutdown."""
         loop = self._loop
         if loop is not None and self._thread is not None:
             async def _shutdown() -> None:
-                # stop accepting, then cancel the per-connection handler
-                # tasks still parked on idle client reads — a close must
-                # not leak destroyed-pending tasks
+                # stop accepting; flip draining BEFORE waiting so frames
+                # that race the close get a clean SHED answer
+                self._draining = True
                 if self._server is not None:
                     self._server.close()
                     await self._server.wait_closed()
+                t_end = loop.time() + max(drain_s, 0.0)
+                while self._inflight > 0 and loop.time() < t_end:
+                    await asyncio.sleep(0.005)
+                # idle handler tasks (parked on client reads) cancel
+                # last — a close must not leak destroyed-pending tasks
                 tasks = [t for t in asyncio.all_tasks()
                          if t is not asyncio.current_task()]
                 for t in tasks:
@@ -148,7 +170,7 @@ class SearchServer:
 
             try:
                 asyncio.run_coroutine_threadsafe(
-                    _shutdown(), loop).result(timeout=10.0)
+                    _shutdown(), loop).result(timeout=drain_s + 10.0)
             except Exception:  # noqa: BLE001 — stop the loop regardless
                 pass
             loop.call_soon_threadsafe(loop.stop)
@@ -160,19 +182,54 @@ class SearchServer:
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         svc = self.svc
+        flags = 0           # negotiated capabilities (T_HELLO handshake)
+        slots = None        # per-connection intern table (slot -> block)
         try:
             while True:
                 frame = await transport.read_frame_async(reader)
                 if frame is None:
                     break
                 ftype, payload = frame
-                svc._m_wire_bytes.inc(transport.HEADER.size + len(payload))
-                if ftype == T_QUERY:
-                    req = transport.decode_query(payload)
-                    await self._answer(writer, req, vectors=False)
-                elif ftype == T_VQUERY:
-                    req = transport.decode_vquery(payload)
-                    await self._answer(writer, req, vectors=True)
+                actual = transport.HEADER.size + len(payload)
+                svc._m_wire_bytes.inc(actual)
+                svc._m_wire_raw.inc(actual)
+                if ftype == T_HELLO:
+                    want = transport.decode_hello(payload)
+                    flags = want & (FLAG_WIRE_COMPRESS if self._compress
+                                    else 0)
+                    if flags & FLAG_WIRE_COMPRESS and slots is None:
+                        slots = {}
+                    await self._write(writer, T_HELLO,
+                                      transport.encode_hello(flags))
+                    continue
+                if ftype in (T_QUERY, T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF):
+                    if self._draining:
+                        # graceful drain: the request is readable (so
+                        # the peer is not left mid-frame) but the
+                        # service is going away — shed, don't serve
+                        # every request head leads with the u64 req id
+                        rid = (transport._ERROR_HEAD.unpack_from(payload)[0]
+                               if len(payload) >= 8 else 0)
+                        svc._shed_deadline("draining", None)
+                        await self._write(writer, T_SHED,
+                                          transport.encode_shed(
+                                              rid, transport.SHED_DRAINING,
+                                              "draining"))
+                        continue
+                    if ftype == T_QUERY:
+                        req = transport.decode_query(payload)
+                        await self._answer(writer, req, vectors=False,
+                                           flags=flags)
+                    else:
+                        req = transport.decode_vquery_any(ftype, payload,
+                                                          slots)
+                        if ftype == T_VQUERY_REF:
+                            # raw-equivalent accounting: this frame
+                            # REPLACED a full query block on the wire
+                            svc._m_wire_raw.inc(req.qv.nbytes
+                                                - transport._SLOT.size)
+                        await self._answer(writer, req, vectors=True,
+                                           flags=flags)
                 else:
                     await self._write(writer, T_ERROR, transport.encode_error(
                         0, f"unexpected frame type {ftype} on a client "
@@ -198,14 +255,15 @@ class SearchServer:
                 pass
 
     async def _write(self, writer: asyncio.StreamWriter, ftype: int,
-                     payload: bytes) -> None:
+                     payload: bytes, raw_len: Optional[int] = None) -> None:
         frame = transport.pack_frame(ftype, payload)
         writer.write(frame)
         self.svc._m_wire_bytes.inc(len(frame))
+        self.svc._m_wire_raw.inc(len(frame) if raw_len is None else raw_len)
         await writer.drain()
 
     async def _answer(self, writer: asyncio.StreamWriter, req,
-                      vectors: bool) -> None:
+                      vectors: bool, flags: int = 0) -> None:
         svc = self.svc
         n = req.qv.shape[0] if vectors else len(req.queries)
         k = req.k or svc.cfg.eval.recall_k
@@ -220,21 +278,40 @@ class SearchServer:
                               n_queries=n, k=k) as root:
             deadline = svc.default_deadline(
                 req.deadline_ms if req.deadline_ms > 0 else None)
+            # in-flight covers the ANSWER write too: a graceful drain
+            # waits until the response frame left, never mid-write
+            self._inflight += 1
             try:
-                scores, ids, scan = await loop.run_in_executor(
-                    self._executor,
-                    lambda: self._dispatch_blocking(root, req, vectors, n,
-                                                    k, nprobe, deadline))
-            except DeadlineExceeded as e:
-                await self._write(writer, T_SHED, transport.encode_shed(
-                    req.req_id, transport.SHED_DEADLINE, str(e)))
-                return
-            except Exception as e:  # noqa: BLE001 — per-request isolation
-                await self._write(writer, T_ERROR, transport.encode_error(
-                    req.req_id, f"{type(e).__name__}: {e}"))
-                return
-            await self._write(writer, T_RESULT, transport.encode_result(
-                req.req_id, scores, ids, scan_bytes=scan))
+                try:
+                    scores, ids, scan = await loop.run_in_executor(
+                        self._executor,
+                        lambda: self._dispatch_blocking(root, req, vectors,
+                                                        n, k, nprobe,
+                                                        deadline))
+                except DeadlineExceeded as e:
+                    await self._write(writer, T_SHED, transport.encode_shed(
+                        req.req_id, transport.SHED_DEADLINE, str(e)))
+                    return
+                except Exception as e:  # noqa: BLE001 — per-request
+                    # isolation
+                    await self._write(writer, T_ERROR,
+                                      transport.encode_error(
+                                          req.req_id,
+                                          f"{type(e).__name__}: {e}"))
+                    return
+                if flags & FLAG_WIRE_COMPRESS:
+                    await self._write(
+                        writer, T_RESULT_C,
+                        transport.encode_result_c(req.req_id, scores, ids,
+                                                  scan_bytes=scan),
+                        raw_len=transport.result_raw_bytes(*scores.shape))
+                else:
+                    await self._write(writer, T_RESULT,
+                                      transport.encode_result(
+                                          req.req_id, scores, ids,
+                                          scan_bytes=scan))
+            finally:
+                self._inflight -= 1
 
     def _dispatch_blocking(self, root, req, vectors: bool, n: int, k: int,
                            nprobe: Optional[int],

@@ -34,9 +34,36 @@ Message types:
     T_RESULT     scores [n, k] f32 + page ids [n, k] i64 + scan bytes
     T_SHED       admission rejection (deadline/SLO budget) — NOT an error
     T_ERROR      server-side failure, message attached
-    T_REGISTER   partition worker hello: (partition, replica, pid)
+    T_REGISTER   partition worker hello: (partition, replica, pid
+                 [, flags, store generation])
     T_HEARTBEAT  worker liveness tick (empty payload)
     T_BYE        clean worker deregistration (empty payload)
+
+Compressed extensions (negotiated — see below — so mixed fleets of
+compressing and raw peers interoperate on one gateway):
+
+    T_RESULT_C   a RESULT whose id block is zigzag-delta+varint encoded
+                 per row; scores stay raw f32 (lossless — byte-identity
+                 pins hold unchanged; ids shrink ~8 -> ~3 bytes each)
+    T_VQUERY_PUT a VQUERY that also interns its query block into a
+                 sender-chosen per-connection cache slot
+    T_VQUERY_REF a VQUERY referencing a previously PUT slot instead of
+                 re-shipping the block — the scatter's dominant wire
+                 cost (the same fp32 block re-sent to every worker on
+                 every request) collapses to a 2-byte slot id
+    T_HELLO      capability exchange on the client edge (the RPC hop
+                 negotiates via REGISTER flags + a HELLO ack)
+    T_REFRESH    control: ask a worker to re-open the store and rebuild
+                 its view (payload = the target store generation); the
+                 worker acks with its own T_REFRESH carrying the
+                 generation it now serves
+
+Negotiation: capability flags (FLAG_WIRE_COMPRESS) are advertised by
+the connecting peer — a worker in its REGISTER frame, a client in a
+leading T_HELLO — and confirmed by the accepting side with a T_HELLO
+carrying the agreed intersection. Nobody sends a compressed or interned
+frame a peer did not advertise, so a raw worker and a compressing
+worker can serve side by side behind one gateway.
 
 Deadlines travel as RELATIVE remaining milliseconds (not absolute
 timestamps): the two ends of a socket do not share a clock, and a
@@ -68,20 +95,39 @@ T_ERROR = 5
 T_REGISTER = 6
 T_HEARTBEAT = 7
 T_BYE = 8
+T_RESULT_C = 9                # compressed RESULT (varint id block)
+T_VQUERY_PUT = 10             # VQUERY + intern block into a cache slot
+T_VQUERY_REF = 11             # VQUERY referencing an interned slot
+T_HELLO = 12                  # capability exchange (flags byte)
+T_REFRESH = 13                # view-refresh control / generation ack
 
 _TYPES = {T_QUERY, T_VQUERY, T_RESULT, T_SHED, T_ERROR, T_REGISTER,
-          T_HEARTBEAT, T_BYE}
+          T_HEARTBEAT, T_BYE, T_RESULT_C, T_VQUERY_PUT, T_VQUERY_REF,
+          T_HELLO, T_REFRESH}
+
+# capability flags (REGISTER / HELLO negotiation)
+FLAG_WIRE_COMPRESS = 0x01     # peer speaks T_RESULT_C + T_VQUERY_PUT/REF
+
+# per-connection intern table size: a protocol constant, so the sender's
+# slot assignment (a ring over these slots) and the receiver's passive
+# slot store can never disagree about capacity
+WIRE_SLOTS = 64
 
 # shed reason codes (T_SHED payload)
 SHED_DEADLINE = 1             # deadline expired / cannot be met
 SHED_QUEUE = 2                # admission queue budget exceeded
+SHED_DRAINING = 3             # front end shutting down (graceful drain)
 
 _QUERY_HEAD = struct.Struct("!QdiiH")     # req id, deadline ms, k, nprobe, nq
 _VQUERY_HEAD = struct.Struct("!QdiiHH")   # ... + n, dim
 _RESULT_HEAD = struct.Struct("!QQHH")     # req id, scan bytes, n, k
 _SHED_HEAD = struct.Struct("!QB")         # req id, reason code
 _ERROR_HEAD = struct.Struct("!Q")         # req id
-_REGISTER_HEAD = struct.Struct("!IIQ")    # partition, replica, pid
+_REGISTER_HEAD = struct.Struct("!IIQ")    # partition, replica, pid (legacy)
+_REGISTER_HEAD2 = struct.Struct("!IIQBQ")  # ... + flags, store generation
+_SLOT = struct.Struct("!H")               # intern slot id
+_HELLO_HEAD = struct.Struct("!B")         # capability flags
+_REFRESH_HEAD = struct.Struct("!Q")       # store generation
 
 _REQ_IDS = itertools.count(1)
 
@@ -180,19 +226,83 @@ def encode_vquery(req_id: int, qv: np.ndarray, k: int = 0, nprobe: int = 0,
             + qv.tobytes())
 
 
+def _block_to_qv(block, n: int, dim: int, what: str) -> np.ndarray:
+    """A raw little-endian f32 block -> [n, dim] array WITHOUT copying:
+    np.frombuffer aliases the (immutable) payload bytes, so the hot RPC
+    decode path stops duplicating a block it immediately re-slices. The
+    result is read-only; every consumer copies at its own boundary
+    (device staging, np.concatenate padding)."""
+    want = n * dim * 4
+    if len(block) != want:
+        raise FrameError(f"{what} carries {len(block)} bytes for a "
+                         f"[{n}, {dim}] f32 matrix ({want} expected)")
+    if n == 0 or dim == 0:
+        raise FrameError(f"{what} is empty")
+    return np.frombuffer(block, dtype="<f4").reshape(n, dim)
+
+
 def decode_vquery(payload: bytes) -> VectorRequest:
     if len(payload) < _VQUERY_HEAD.size:
         raise FrameError("vquery frame shorter than its fixed header")
     req_id, deadline_ms, k, nprobe, n, dim = _VQUERY_HEAD.unpack_from(payload)
-    body = payload[_VQUERY_HEAD.size:]
-    want = n * dim * 4
-    if len(body) != want:
-        raise FrameError(f"vquery block carries {len(body)} bytes for a "
-                         f"[{n}, {dim}] f32 matrix ({want} expected)")
-    if n == 0 or dim == 0:
-        raise FrameError("vquery block is empty")
-    qv = np.frombuffer(body, dtype="<f4").reshape(n, dim).astype(
-        np.float32, copy=True)
+    qv = _block_to_qv(memoryview(payload)[_VQUERY_HEAD.size:], n, dim,
+                      "vquery block")
+    return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
+
+
+def encode_vquery_put(req_id: int, slot: int, block: bytes, n: int,
+                      dim: int, k: int = 0, nprobe: int = 0,
+                      deadline_ms: float = 0.0) -> bytes:
+    """A VQUERY that also interns its (already encoded) query block into
+    the receiver's per-connection cache slot `slot`."""
+    return (_VQUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
+                              int(nprobe), n, dim)
+            + _SLOT.pack(slot) + block)
+
+
+def encode_vquery_ref(req_id: int, slot: int, n: int, dim: int,
+                      k: int = 0, nprobe: int = 0,
+                      deadline_ms: float = 0.0) -> bytes:
+    """A VQUERY whose block was interned earlier on this connection: the
+    per-request head plus a 2-byte slot id instead of n*dim*4 raw f32."""
+    return (_VQUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
+                              int(nprobe), n, dim) + _SLOT.pack(slot))
+
+
+def decode_vquery_any(ftype: int, payload: bytes,
+                      slots: Optional[Dict[int, bytes]] = None
+                      ) -> VectorRequest:
+    """Decode T_VQUERY / T_VQUERY_PUT / T_VQUERY_REF. `slots` is the
+    receiver's per-connection intern table: PUT stores its block there
+    (a stable bytes copy — the slot outlives this frame), REF resolves
+    against it. A REF to a slot never PUT on this connection is a
+    protocol violation -> FrameError (the sender controls slot reuse, so
+    the two tables can only disagree if the peer is broken)."""
+    if ftype == T_VQUERY:
+        return decode_vquery(payload)
+    if len(payload) < _VQUERY_HEAD.size + _SLOT.size:
+        raise FrameError("interned vquery frame shorter than its header")
+    req_id, deadline_ms, k, nprobe, n, dim = _VQUERY_HEAD.unpack_from(payload)
+    (slot,) = _SLOT.unpack_from(payload, _VQUERY_HEAD.size)
+    if slot >= WIRE_SLOTS:
+        raise FrameError(f"intern slot {slot} out of range "
+                         f"(WIRE_SLOTS {WIRE_SLOTS})")
+    if slots is None:
+        raise FrameError("interned vquery on a connection that never "
+                         "negotiated compression")
+    off = _VQUERY_HEAD.size + _SLOT.size
+    if ftype == T_VQUERY_PUT:
+        block = bytes(memoryview(payload)[off:])
+        qv = _block_to_qv(block, n, dim, "interned vquery block")
+        slots[slot] = block
+        return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
+    if len(payload) != off:
+        raise FrameError(f"{len(payload) - off} trailing bytes after a "
+                         "vquery slot reference")
+    block = slots.get(slot)
+    if block is None:
+        raise FrameError(f"vquery references empty intern slot {slot}")
+    qv = _block_to_qv(block, n, dim, "interned vquery block")
     return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
 
 
@@ -210,21 +320,147 @@ def encode_result(req_id: int, scores: np.ndarray, ids: np.ndarray,
 
 def decode_result(payload: bytes
                   ) -> Tuple[int, np.ndarray, np.ndarray, int]:
-    """-> (req_id, scores [n, k] f32, ids [n, k] i64, scan_bytes)."""
+    """-> (req_id, scores [n, k] f32, ids [n, k] i64, scan_bytes).
+    Zero-copy: both arrays alias the (immutable) payload bytes via
+    np.frombuffer at an offset — no slice copy, no astype copy."""
     if len(payload) < _RESULT_HEAD.size:
         raise FrameError("result frame shorter than its fixed header")
     req_id, scan_bytes, n, k = _RESULT_HEAD.unpack_from(payload)
-    body = payload[_RESULT_HEAD.size:]
+    body_len = len(payload) - _RESULT_HEAD.size
     want = n * k * (4 + 8)
-    if len(body) != want:
-        raise FrameError(f"result block carries {len(body)} bytes for "
+    if body_len != want:
+        raise FrameError(f"result block carries {body_len} bytes for "
                          f"[{n}, {k}] scores+ids ({want} expected)")
-    cut = n * k * 4
-    scores = np.frombuffer(body[:cut], dtype="<f4").reshape(n, k).astype(
-        np.float32, copy=True)
-    ids = np.frombuffer(body[cut:], dtype="<i8").reshape(n, k).astype(
-        np.int64, copy=True)
+    scores = np.frombuffer(payload, dtype="<f4", count=n * k,
+                           offset=_RESULT_HEAD.size).reshape(n, k)
+    ids = np.frombuffer(payload, dtype="<i8", count=n * k,
+                        offset=_RESULT_HEAD.size + n * k * 4).reshape(n, k)
     return req_id, scores, ids, int(scan_bytes)
+
+
+# -- varints (the compressed RESULT id block) -------------------------------
+#
+# LEB128 with a 10-byte cap (enough for any 64-bit zigzag delta — even
+# the worst case, -1 next to 2^63-1, fits 65 bits = 10 septets). The cap
+# is what makes adversarial continuation bytes REJECT instead of parsing
+# unboundedly.
+
+_VARINT_MAX_BYTES = 10
+
+
+def _append_uvarint(out: bytearray, v: int) -> None:
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def _read_uvarint(payload, off: int) -> Tuple[int, int]:
+    """-> (value, next offset); FrameError on truncation mid-varint or a
+    continuation run past the 10-byte cap."""
+    v = 0
+    shift = 0
+    end = len(payload)
+    for i in range(_VARINT_MAX_BYTES):
+        if off >= end:
+            raise FrameError("stream truncated inside a varint")
+        b = payload[off]
+        off += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, off
+        shift += 7
+    raise FrameError(f"varint longer than {_VARINT_MAX_BYTES} bytes "
+                     "(unterminated continuation run)")
+
+
+_I64_MIN, _I64_MAX = -(2 ** 63), 2 ** 63 - 1
+
+
+def _encode_ids_compressed(ids: np.ndarray) -> bytearray:
+    """[n, k] int64 page ids -> per-row zigzag-delta varint block. Rows
+    restart their delta chain (prev = 0), so one row's ids stay
+    independently decodable and a result row full of -1 padding costs
+    one byte per slot. Top-k ids are draws from a bounded id space, so
+    deltas carry ~log2(store rows) bits instead of 64 — the ~3x cut."""
+    out = bytearray()
+    for row in ids:
+        prev = 0
+        for v in row:
+            d = int(v) - prev
+            prev = int(v)
+            # zigzag over plain python ints: 2d for d >= 0, -2d-1 below
+            _append_uvarint(out, d << 1 if d >= 0 else (d << 1) ^ -1)
+    return out
+
+
+def _decode_ids_compressed(payload, off: int, n: int, k: int) -> np.ndarray:
+    ids = np.empty((n, k), np.int64)
+    for r in range(n):
+        prev = 0
+        row = ids[r]
+        for c in range(k):
+            zz, off = _read_uvarint(payload, off)
+            d = (zz >> 1) ^ -(zz & 1)
+            prev += d
+            if not _I64_MIN <= prev <= _I64_MAX:
+                raise FrameError(f"compressed id delta overflows int64 "
+                                 f"(row {r}, col {c})")
+            row[c] = prev
+    if off != len(payload):
+        raise FrameError(f"{len(payload) - off} trailing bytes after the "
+                         "compressed id block")
+    return ids
+
+
+def encode_result_c(req_id: int, scores: np.ndarray, ids: np.ndarray,
+                    scan_bytes: int = 0) -> bytes:
+    """The compressed RESULT payload: same fixed head, raw little-endian
+    f32 scores (lossless — the byte-identity pins hold unchanged), then
+    the zigzag-delta varint id block."""
+    scores = np.ascontiguousarray(scores, dtype="<f4")
+    ids = np.ascontiguousarray(ids, dtype="<i8")
+    if scores.shape != ids.shape or scores.ndim != 2:
+        raise ValueError(f"scores {scores.shape} / ids {ids.shape} must be "
+                         "matching [n, k]")
+    n, k = scores.shape
+    return (_RESULT_HEAD.pack(req_id, int(scan_bytes), n, k)
+            + scores.tobytes() + bytes(_encode_ids_compressed(ids)))
+
+
+def decode_result_c(payload: bytes
+                    ) -> Tuple[int, np.ndarray, np.ndarray, int]:
+    """-> (req_id, scores [n, k] f32, ids [n, k] i64, scan_bytes); the
+    scores alias the payload (zero-copy), the ids materialize out of the
+    varint block. Truncation anywhere — inside the score block, mid-
+    varint, or short of n*k ids — and trailing bytes all REJECT."""
+    if len(payload) < _RESULT_HEAD.size:
+        raise FrameError("result frame shorter than its fixed header")
+    req_id, scan_bytes, n, k = _RESULT_HEAD.unpack_from(payload)
+    cut = _RESULT_HEAD.size + n * k * 4
+    if len(payload) < cut:
+        raise FrameError(f"compressed result truncated inside the score "
+                         f"block ({len(payload) - _RESULT_HEAD.size}/"
+                         f"{n * k * 4} bytes)")
+    scores = np.frombuffer(payload, dtype="<f4", count=n * k,
+                           offset=_RESULT_HEAD.size).reshape(n, k)
+    ids = _decode_ids_compressed(payload, cut, n, k)
+    return req_id, scores, ids, int(scan_bytes)
+
+
+def decode_result_any(ftype: int, payload: bytes
+                      ) -> Tuple[int, np.ndarray, np.ndarray, int]:
+    """Raw or compressed RESULT, by frame type — receivers accept both
+    unconditionally (negotiation only governs what a peer SENDS)."""
+    if ftype == T_RESULT_C:
+        return decode_result_c(payload)
+    return decode_result(payload)
+
+
+def result_raw_bytes(n: int, k: int) -> int:
+    """What a [n, k] RESULT costs as a raw frame (header included) — the
+    raw-equivalent side of the wire-compression accounting."""
+    return HEADER.size + _RESULT_HEAD.size + n * k * (4 + 8)
 
 
 def encode_shed(req_id: int, code: int, reason: str) -> bytes:
@@ -251,14 +487,48 @@ def decode_error(payload: bytes) -> Tuple[int, str]:
         "utf-8", errors="replace")
 
 
-def encode_register(partition: int, replica: int, pid: int) -> bytes:
-    return _REGISTER_HEAD.pack(partition, replica, pid)
+def encode_register(partition: int, replica: int, pid: int,
+                    flags: int = 0, generation: int = 0) -> bytes:
+    """Worker hello. `flags` advertises capabilities (FLAG_WIRE_COMPRESS
+    = this worker answers T_RESULT_C and accepts interned VQUERYs once
+    the gateway confirms with a T_HELLO); `generation` is the store
+    generation the worker's view serves — the gateway routes around a
+    worker whose generation lags the front end's (it serves that slice
+    locally) until a T_REFRESH ack catches it up."""
+    return _REGISTER_HEAD2.pack(partition, replica, pid, flags, generation)
 
 
-def decode_register(payload: bytes) -> Tuple[int, int, int]:
-    if len(payload) != _REGISTER_HEAD.size:
+def decode_register(payload: bytes) -> Tuple[int, int, int, int, int]:
+    """-> (partition, replica, pid, flags, generation). Accepts the
+    legacy 16-byte form (a raw pre-compression worker: flags 0,
+    generation 0) next to the extended one — mixed fleets register on
+    one gateway."""
+    if len(payload) == _REGISTER_HEAD.size:
+        partition, replica, pid = _REGISTER_HEAD.unpack(payload)
+        return partition, replica, pid, 0, 0
+    if len(payload) != _REGISTER_HEAD2.size:
         raise FrameError("register frame has the wrong size")
-    return _REGISTER_HEAD.unpack(payload)
+    return _REGISTER_HEAD2.unpack(payload)
+
+
+def encode_hello(flags: int) -> bytes:
+    return _HELLO_HEAD.pack(flags & 0xFF)
+
+
+def decode_hello(payload: bytes) -> int:
+    if len(payload) != _HELLO_HEAD.size:
+        raise FrameError("hello frame has the wrong size")
+    return _HELLO_HEAD.unpack(payload)[0]
+
+
+def encode_refresh(generation: int) -> bytes:
+    return _REFRESH_HEAD.pack(int(generation))
+
+
+def decode_refresh(payload: bytes) -> int:
+    if len(payload) != _REFRESH_HEAD.size:
+        raise FrameError("refresh frame has the wrong size")
+    return _REFRESH_HEAD.unpack(payload)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -323,6 +593,84 @@ def write_frame(sock: socket.socket, ftype: int, payload: bytes = b"",
     return len(frame)
 
 
+def _byte_view(part) -> memoryview:
+    """Any bytes-like (incl. a contiguous np array) -> a flat byte view
+    with a correct len() — no tobytes() copy on the encode path."""
+    if isinstance(part, np.ndarray):
+        return memoryview(np.ascontiguousarray(part)).cast("B")
+    return memoryview(part)
+
+
+class FrameSender:
+    """Per-connection reused encode buffer: the frame — header plus
+    payload parts — is assembled in ONE resident bytearray and shipped
+    with ONE coalesced sendall, so the hot send path stops allocating
+    and concatenating per frame (the old pack_frame built the payload
+    from joined parts, then concatenated the header on top: two fresh
+    allocations and two copies per RESULT). NOT thread-safe — every
+    caller already serializes its connection writes (the worker/gateway
+    wlock, the client's thread-local connection)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        # owned by the connection's single writer (see class docstring)
+        self._buf = bytearray(8192)
+
+    def send(self, ftype: int, *parts, counter=None, raw_counter=None,
+             raw_len: Optional[int] = None) -> int:
+        """Assemble + send one frame; returns wire bytes written.
+        `raw_len` is the raw-equivalent frame size for compression
+        accounting (defaults to the actual size — uncompressed frames
+        count 1:1)."""
+        views = [_byte_view(p) for p in parts]
+        total = HEADER.size + sum(len(v) for v in views)
+        buf = self._buf
+        if len(buf) < total:
+            buf = self._buf = bytearray(total)
+        HEADER.pack_into(buf, 0, MAGIC, ftype, total - HEADER.size)
+        off = HEADER.size
+        for v in views:
+            buf[off: off + len(v)] = v
+            off += len(v)
+        self.sock.sendall(memoryview(buf)[:total])
+        if counter is not None:
+            counter.inc(total)
+        if raw_counter is not None:
+            raw_counter.inc(total if raw_len is None else raw_len)
+        return total
+
+
+class InternTable:
+    """SENDER side of the per-connection query-block interning: block
+    bytes -> slot id, with a deterministic ring over WIRE_SLOTS slots.
+    The sender alone decides slot reuse (the receiver's table is a
+    passive slot -> bytes store that PUT overwrites), so eviction can
+    never desynchronize the two ends. NOT thread-safe — owned by the
+    connection's writer."""
+
+    def __init__(self, cap: int = WIRE_SLOTS):
+        self._cap = int(cap)
+        self._by_key: Dict[bytes, int] = {}
+        self._keys: List[Optional[bytes]] = [None] * self._cap
+        self._next = 0
+
+    def slot_for(self, key: bytes) -> Tuple[int, bool]:
+        """-> (slot, fresh): fresh means the block must ride this frame
+        (a PUT); a stale slot's previous occupant is forgotten here the
+        same instant the receiver's PUT overwrites it there."""
+        slot = self._by_key.get(key)
+        if slot is not None:
+            return slot, False
+        slot = self._next
+        self._next = (self._next + 1) % self._cap
+        old = self._keys[slot]
+        if old is not None:
+            del self._by_key[old]
+        self._keys[slot] = key
+        self._by_key[key] = slot
+        return slot, True
+
+
 # ---------------------------------------------------------------------------
 # framing over asyncio streams (the front-end server)
 # ---------------------------------------------------------------------------
@@ -358,34 +706,77 @@ class SocketSearchClient:
     connection (thread-local), so concurrent trial workers never
     interleave frames on one socket. `search()` mirrors
     `SearchService.search`'s signature, so `loadgen/driver.py:run_trial`
-    can point its issue loop at a client unchanged."""
+    can point its issue loop at a client unchanged.
+
+    With `compress` (the default) each fresh connection leads with a
+    T_HELLO advertising FLAG_WIRE_COMPRESS; the server answers with the
+    agreed intersection. On a compressing connection, repeated query
+    blocks intern into per-connection slots (PUT once, 2-byte REF after)
+    and results arrive as T_RESULT_C — both lossless. A server that does
+    not answer the HELLO (a pre-compression peer closes on the unknown
+    frame) is remembered and the client reconnects raw."""
 
     def __init__(self, host: str, port: int, deadline_ms: float = 0.0,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, compress: bool = True):
         self.host = host
         self.port = int(port)
         self.deadline_ms = float(deadline_ms)
         self.timeout_s = float(timeout_s)
+        self.compress = bool(compress)
         self._local = threading.local()
         self._lock = threading.Lock()
         self._conns: List[socket.socket] = []   # guarded-by: _lock
+        self._legacy_server = False             # guarded-by: _lock
 
-    def _conn(self) -> socket.socket:
+    def _conn(self):
+        """-> (sock, sender, flags, intern): this thread's connection
+        state, dialing + negotiating on first use."""
         sock = getattr(self._local, "sock", None)
-        if sock is None:
-            sock = socket.create_connection((self.host, self.port),
-                                            timeout=self.timeout_s)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._local.sock = sock
-            with self._lock:
-                self._conns.append(sock)
-        return sock
+        if sock is not None:
+            return (sock, self._local.sender, self._local.flags,
+                    self._local.intern)
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sender = FrameSender(sock)
+        flags = 0
+        with self._lock:
+            attempt_hello = self.compress and not self._legacy_server
+        if attempt_hello:
+            try:
+                sender.send(T_HELLO, encode_hello(FLAG_WIRE_COMPRESS))
+                frame = read_frame(sock)
+            except (OSError, FrameError):
+                frame = None
+            if frame is not None and frame[0] == T_HELLO:
+                flags = decode_hello(frame[1])
+            else:
+                # a pre-compression server errors/closes on T_HELLO:
+                # remember and redial raw so every later connection
+                # skips the doomed handshake
+                with self._lock:
+                    self._legacy_server = True
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=self.timeout_s)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sender = FrameSender(sock)
+        self._local.sock = sock
+        self._local.sender = sender
+        self._local.flags = flags
+        self._local.intern = InternTable()
+        with self._lock:
+            self._conns.append(sock)
+        return sock, sender, flags, self._local.intern
 
-    def _roundtrip(self, ftype: int, payload: bytes,
+    def _roundtrip(self, ftype: int, parts: Tuple,
                    req_id: int) -> Tuple[np.ndarray, np.ndarray, int]:
-        sock = self._conn()
+        sock, sender, _, _ = self._conn()
         try:
-            write_frame(sock, ftype, payload)
+            sender.send(ftype, *parts)
             frame = read_frame(sock)
         except (OSError, FrameError):
             # a broken connection must not poison the thread's next call
@@ -395,8 +786,8 @@ class SocketSearchClient:
             self._drop_local()
             raise RemoteError("server closed the connection mid-request")
         rtype, body = frame
-        if rtype == T_RESULT:
-            rid, scores, ids, scan = decode_result(body)
+        if rtype in (T_RESULT, T_RESULT_C):
+            rid, scores, ids, scan = decode_result_any(rtype, body)
             if rid != req_id:
                 self._drop_local()
                 raise RemoteError(f"response for request {rid} arrived on "
@@ -415,6 +806,9 @@ class SocketSearchClient:
         sock = getattr(self._local, "sock", None)
         if sock is not None:
             self._local.sock = None
+            self._local.sender = None
+            self._local.flags = 0
+            self._local.intern = None
             try:
                 sock.close()
             except OSError:
@@ -439,19 +833,38 @@ class SocketSearchClient:
         dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
         payload = encode_query(req_id, list(queries), k=k or 0,
                                nprobe=nprobe or 0, deadline_ms=dl)
-        return self._roundtrip(T_QUERY, payload, req_id)
+        return self._roundtrip(T_QUERY, (payload,), req_id)
 
     def topk_vectors(self, qv: np.ndarray, k: Optional[int] = None,
                      nprobe: Optional[int] = None,
                      deadline_ms: Optional[float] = None
                      ) -> Tuple[np.ndarray, np.ndarray, int]:
         """Raw vector retrieval over the wire (the model-free twin of
-        `SearchService.topk_vectors`): (scores, ids, scan_bytes)."""
+        `SearchService.topk_vectors`): (scores, ids, scan_bytes). On a
+        compressing connection the query block interns — a repeated
+        block ships once and costs a 2-byte slot reference after."""
         req_id = next_request_id()
         dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
-        payload = encode_vquery(req_id, qv, k=k or 0, nprobe=nprobe or 0,
+        block = np.ascontiguousarray(qv, dtype="<f4")
+        if block.ndim != 2 or not 0 < block.shape[0] <= 0xFFFF \
+                or not 0 < block.shape[1] <= 0xFFFF:
+            raise ValueError(f"query block must be [1..65535, 1..65535], "
+                             f"got {block.shape}")
+        n, dim = block.shape
+        _, _, flags, intern = self._conn()
+        if flags & FLAG_WIRE_COMPRESS:
+            key = block.tobytes()
+            slot, fresh = intern.slot_for(key)
+            head = _VQUERY_HEAD.pack(req_id, dl, int(k or 0),
+                                     int(nprobe or 0), n, dim)
+            if fresh:
+                parts = (head, _SLOT.pack(slot), key)
+                return self._roundtrip(T_VQUERY_PUT, parts, req_id)
+            return self._roundtrip(T_VQUERY_REF,
+                                   (head, _SLOT.pack(slot)), req_id)
+        payload = encode_vquery(req_id, block, k=k or 0, nprobe=nprobe or 0,
                                 deadline_ms=dl)
-        return self._roundtrip(T_VQUERY, payload, req_id)
+        return self._roundtrip(T_VQUERY, (payload,), req_id)
 
     def close(self) -> None:
         with self._lock:

@@ -70,6 +70,7 @@ def snapshot_line(svc, extra: Optional[Dict] = None) -> str:
     # byte-identical to the pre-transport format
     transport = m.get("transport") or {}
     rec["wire_bytes"] = transport.get("wire_bytes")
+    rec["wire_compression_ratio"] = transport.get("wire_compression_ratio")
     rec["deadline_sheds"] = transport.get("deadline_sheds")
     rec["hedge_fires"] = transport.get("hedge_fires")
     rec["workers_live"] = transport.get("workers_live")
@@ -225,12 +226,16 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         # Counter keys are PER-TRIAL deltas against the trial-start
         # snapshot; topology keys (workers_live) report the end state.
         blk: Dict = {}
-        for key in ("wire_bytes", "deadline_sheds", "hedge_fires",
-                    "rpcs", "rpc_fallbacks"):
+        for key in ("wire_bytes", "wire_raw_bytes", "deadline_sheds",
+                    "hedge_fires", "rpcs", "rpc_fallbacks"):
             new = (transport1 or {}).get(key)
             if new is not None:
                 blk[key] = new - transport0.get(key, 0)
-        for key in ("workers_live", "workers_registered"):
+        if "wire_raw_bytes" in blk and blk.get("wire_bytes"):
+            blk["wire_compression_ratio"] = round(
+                blk["wire_raw_bytes"] / blk["wire_bytes"], 3)
+        for key in ("workers_live", "workers_registered",
+                    "workers_compressing"):
             if transport1 and key in transport1:
                 blk[key] = transport1[key]
         if sheds:

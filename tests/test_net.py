@@ -131,7 +131,17 @@ def test_frame_codec_roundtrip():
         3, transport.SHED_DEADLINE, "late")) == (
             3, transport.SHED_DEADLINE, "late")
     assert transport.decode_register(
-        transport.encode_register(2, 1, 999)) == (2, 1, 999)
+        transport.encode_register(2, 1, 999)) == (2, 1, 999, 0, 0)
+    # the extended REGISTER carries capability flags + store generation;
+    # the legacy 16-byte form (a raw pre-compression worker) still
+    # decodes — mixed fleets register on one gateway
+    assert transport.decode_register(transport.encode_register(
+        2, 1, 999, flags=transport.FLAG_WIRE_COMPRESS,
+        generation=7)) == (2, 1, 999, transport.FLAG_WIRE_COMPRESS, 7)
+    assert transport.decode_register(
+        transport._REGISTER_HEAD.pack(3, 0, 42)) == (3, 0, 42, 0, 0)
+    assert transport.decode_hello(transport.encode_hello(1)) == 1
+    assert transport.decode_refresh(transport.encode_refresh(9)) == 9
 
 
 def test_frame_fuzz_truncation_garbage_oversize():
@@ -198,6 +208,106 @@ def test_read_frame_truncation_vs_clean_eof():
             transport.read_frame(a)
     finally:
         a.close()
+
+
+# ---------------------------------------------------------------------------
+# compressed wire extensions: codec roundtrip + adversarial fuzz
+# ---------------------------------------------------------------------------
+
+def test_compressed_result_roundtrip_and_fuzz():
+    """The compressed RESULT codec is LOSSLESS (exact f32 scores, exact
+    i64 ids incl. -1 padding and int64 extremes) and measurably smaller;
+    every truncation — mid-score-block, mid-varint, short of n*k ids —
+    plus trailing bytes, unterminated varint continuation runs, and
+    deltas that overflow int64 all REJECT with FrameError."""
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 131072, size=(3, 10)).astype(np.int64)
+    ids[1, 7:] = -1                       # -1-padded short result rows
+    ids[2, 0] = 2 ** 63 - 1               # zigzag's worst-case neighbors
+    ids[2, 1] = -1
+    scores = rng.standard_normal((3, 10)).astype(np.float32)
+    comp = transport.encode_result_c(11, scores, ids, scan_bytes=777)
+    raw = transport.encode_result(11, scores, ids, scan_bytes=777)
+    rid, s2, i2, scan = transport.decode_result_c(comp)
+    assert rid == 11 and scan == 777
+    assert np.array_equal(s2, scores) and np.array_equal(i2, ids)
+    assert len(comp) < len(raw)           # the id block actually shrank
+    # decode_result_any dispatches on frame type
+    rid2, s3, i3, _ = transport.decode_result_any(transport.T_RESULT_C,
+                                                  comp)
+    assert rid2 == 11 and np.array_equal(i3, ids)
+    for cut in range(len(comp)):          # EVERY proper prefix rejects
+        with pytest.raises(FrameError):
+            transport.decode_result_c(comp[:cut])
+    with pytest.raises(FrameError):       # trailing bytes reject
+        transport.decode_result_c(comp + b"\x00")
+    # adversarial continuation bytes: a varint that never terminates
+    # must reject at the 10-byte cap, not parse unboundedly
+    head = comp[: transport._RESULT_HEAD.size + 3 * 10 * 4]
+    with pytest.raises(FrameError):
+        transport.decode_result_c(head + b"\x80" * 64)
+    # an oversize delta: a maximal terminated varint walks the running
+    # id out of int64 range -> clean REJECT (never a wrapped id)
+    big = bytearray()
+    for _ in range(30):
+        transport._append_uvarint(big, (1 << 64) - 2)   # delta 2^63 - 1
+    with pytest.raises(FrameError):
+        transport.decode_result_c(head + bytes(big))
+    # random byte flips decode or FrameError — nothing else ever
+    for _ in range(200):
+        mutated = bytearray(comp)
+        pos = int(rng.integers(0, len(mutated)))
+        mutated[pos] = int(rng.integers(0, 256))
+        try:
+            transport.decode_result_c(bytes(mutated))
+        except FrameError:
+            pass
+
+
+def test_vquery_intern_put_ref_codec():
+    """Per-connection query-block interning: PUT stores + serves, REF
+    resolves byte-identically, and every protocol violation — an empty
+    or out-of-range slot, a REF on a connection that never negotiated,
+    truncation — REJECTS."""
+    qv = _qv(2, seed=9)
+    block = np.ascontiguousarray(qv, "<f4").tobytes()
+    slots = {}
+    put = transport.encode_vquery_put(5, 3, block, 2, DIM, k=7,
+                                      deadline_ms=12.5)
+    r = transport.decode_vquery_any(transport.T_VQUERY_PUT, put, slots)
+    assert np.array_equal(r.qv, qv) and r.k == 7 and 3 in slots
+    ref = transport.encode_vquery_ref(6, 3, 2, DIM, k=7)
+    r2 = transport.decode_vquery_any(transport.T_VQUERY_REF, ref, slots)
+    assert np.array_equal(r2.qv, qv) and r2.req_id == 6
+    for cut in range(len(ref)):
+        with pytest.raises(FrameError):
+            transport.decode_vquery_any(transport.T_VQUERY_REF,
+                                        ref[:cut], slots)
+    with pytest.raises(FrameError):       # REF to a slot never PUT
+        transport.decode_vquery_any(
+            transport.T_VQUERY_REF,
+            transport.encode_vquery_ref(7, 9, 2, DIM), slots)
+    with pytest.raises(FrameError):       # slot id past WIRE_SLOTS
+        transport.decode_vquery_any(
+            transport.T_VQUERY_REF,
+            transport.encode_vquery_ref(7, transport.WIRE_SLOTS, 2, DIM),
+            slots)
+    with pytest.raises(FrameError):       # un-negotiated connection
+        transport.decode_vquery_any(transport.T_VQUERY_REF, ref, None)
+    # a mismatched REF geometry (stored block vs claimed [n, dim])
+    with pytest.raises(FrameError):
+        transport.decode_vquery_any(
+            transport.T_VQUERY_REF,
+            transport.encode_vquery_ref(8, 3, 3, DIM), slots)
+    # sender-side ring: deterministic slot reuse, stale keys forgotten
+    tab = transport.InternTable(cap=2)
+    s0, fresh0 = tab.slot_for(b"a")
+    s1, fresh1 = tab.slot_for(b"b")
+    assert (fresh0, fresh1) == (True, True) and s0 != s1
+    assert tab.slot_for(b"a") == (s0, False)        # warm hit
+    s2, fresh2 = tab.slot_for(b"c")                 # evicts the ring slot
+    assert fresh2 and s2 == s0
+    assert tab.slot_for(b"a")[1] is True            # "a" was evicted
 
 
 # ---------------------------------------------------------------------------
@@ -588,6 +698,223 @@ def test_cli_partition_worker_subprocess(net_store, mesh):
 
 
 # ---------------------------------------------------------------------------
+# compressed path end to end: byte identity, mixed fleets, refresh, drain
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("P,R", [(2, 1), (4, 1), (2, 2)])
+def test_compressed_path_byte_identity(net_store, mesh, P, R):
+    """THE acceptance pin, compressed edition: with wire compression
+    negotiated fleet-wide, socket results stay byte-identical to the
+    in-process scatter at every tested (P, R) — and the wire accounting
+    proves compression actually engaged (raw-equivalent bytes > actual,
+    zero fallbacks, every worker answering compressed)."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=P, replicas=R)
+    qv = _qv(3)
+    base_s, base_i = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    workers = []
+    try:
+        for p in range(P):
+            for r in range(R):
+                workers.append(_thread_worker(
+                    svc.cfg, net_store.directory, gw.port, p, P, r, mesh))
+        assert gw.wait_for_workers(P * R, timeout_s=60.0)
+        for seed in (1, 2, 3):            # repeats exercise the REF path
+            s, i = svc.topk_vectors(_qv(3, seed=seed), k=10)
+            if seed == 1:
+                assert np.array_equal(s, base_s)
+                assert np.array_equal(i, base_i)
+        st = gw.stats()
+        assert st["rpc_fallbacks"] == 0
+        assert st["workers_compressing"] == P * R
+        assert svc.wire_raw_bytes > svc.wire_bytes
+        met = svc.metrics()["transport"]
+        assert met["wire_compression_ratio"] > 1.0
+        assert met["wire_raw_bytes"] == svc.wire_raw_bytes
+    finally:
+        for w, _ in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+def test_mixed_compressed_raw_fleet_interop(net_store, mesh):
+    """Negotiation keeps a mixed fleet coherent: one worker advertises
+    compression, its sibling partition runs raw (wire_compress off) —
+    both register on one gateway, the scatter spans both, and results
+    stay byte-identical to in-process."""
+    import dataclasses
+
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=2)
+    qv = _qv(3)
+    base_s, base_i = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    raw_cfg = svc.cfg.replace(serve=dataclasses.replace(
+        svc.cfg.serve, wire_compress=False))
+    workers = []
+    try:
+        workers.append(_thread_worker(svc.cfg, net_store.directory,
+                                      gw.port, 0, 2, 0, mesh))
+        workers.append(_thread_worker(raw_cfg, net_store.directory,
+                                      gw.port, 1, 2, 0, mesh))
+        assert gw.wait_for_workers(2, timeout_s=60.0)
+        for _ in range(3):
+            s, i = svc.topk_vectors(qv, k=10)
+            assert np.array_equal(s, base_s)
+            assert np.array_equal(i, base_i)
+        st = gw.stats()
+        assert st["rpc_fallbacks"] == 0 and st["workers_live"] == 2
+        assert st["workers_compressing"] == 1      # the mixed fleet
+        reg = {(e["attrs"]["partition"], e["attrs"]["wire_compress"])
+               for e in svc.registry.events()
+               if e["event"] == "worker_registered"}
+        assert reg == {(0, True), (1, False)}
+    finally:
+        for w, _ in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+@pytest.mark.parametrize("P", [1, 2])
+def test_refresh_control_frame_no_worker_restart(tmp_path, mesh, P):
+    """ROADMAP item 1 residue: a store generation swap reaches the wire
+    fleet as a T_REFRESH control frame — the worker re-opens the store,
+    rebuilds its restricted view, acks the generation it now serves, and
+    answers byte-identically to a freshly RESTARTED worker, with no
+    restart. Until the ack lands, routing treats the worker as
+    generation-stale and serves its slice locally, so results never mix
+    generations across the wire. P=1 exercises the single-view service
+    whose gateway owns its private 1-partition set (that table must
+    follow the refresh too)."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    sdir = str(tmp_path / "store")
+    rng = np.random.default_rng(3)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    for si in range(4):
+        v = rng.standard_normal((SHARD, DIM)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(si * SHARD, (si + 1) * SHARD,
+                                        dtype=np.int64), v)
+    store = VectorStore(sdir)
+    svc = _service(store, mesh, partitions=P)
+    qv = _qv(2)
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    workers = []
+    try:
+        for p in range(P):
+            workers.append(_thread_worker(svc.cfg, sdir, gw.port, p, P, 0,
+                                          mesh))
+        assert gw.wait_for_workers(P, timeout_s=60.0)
+        s0, i0 = svc.topk_vectors(qv, k=10)
+        rpcs0 = gw.stats()["rpcs"]
+        assert rpcs0 >= P and gw.stats()["rpc_fallbacks"] == 0
+        # the store appends a generation behind the fleet's back ...
+        grow = VectorStore(sdir)
+        writer = grow.begin_generation()
+        start = grow.next_page_id()
+        v = rng.standard_normal((SHARD, DIM)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        writer.write_shard(np.arange(start, start + SHARD,
+                                     dtype=np.int64), v)
+        writer.commit()
+        # ... refresh() swaps the front end AND broadcasts T_REFRESH
+        info = svc.refresh()
+        assert info["workers_refresh"]["workers_told"] == P
+        new_gen = svc._view.generation
+        assert gw.wait_for_generation(new_gen, timeout_s=60.0), \
+            "workers never acked the refreshed generation"
+        s1, i1 = svc.topk_vectors(qv, k=10)
+        rpcs1 = gw.stats()["rpcs"]
+        assert rpcs1 > rpcs0, "post-refresh queries stopped using workers"
+        assert gw.stats()["rpc_fallbacks"] == 0
+        # the restarted-worker oracle: a FRESH service over the grown
+        # store is what a restarted worker would serve by construction
+        oracle = _service(VectorStore(sdir), mesh, partitions=P)
+        try:
+            so, io = oracle.topk_vectors(qv, k=10)
+        finally:
+            oracle.close()
+        assert np.array_equal(s1, so) and np.array_equal(i1, io)
+        evs = [e for e in svc.registry.events()
+               if e["event"] == "worker_refreshed"]
+        assert len(evs) >= P
+        assert all(e["attrs"]["generation"] == new_gen for e in evs[-P:])
+    finally:
+        for w, _ in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+def test_graceful_drain_finishes_inflight_sheds_new(net_store, mesh):
+    """serve.listen close path: an in-flight request FINISHES and gets
+    its result; a request arriving while draining is shed with reason
+    "draining" (counted in serve.deadline_shed, never an error, never a
+    dropped socket mid-frame)."""
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    svc = _service(net_store, mesh)
+    srv = serve_in_background(svc)
+    hold = threading.Event()
+    entered = threading.Event()
+    real_topk = svc.topk_vectors
+
+    def slow_topk(qv, **kw):
+        entered.set()
+        hold.wait(10.0)
+        return real_topk(qv, **kw)
+
+    svc.topk_vectors = slow_topk
+    c1 = SocketSearchClient(srv.host, srv.port)
+    c2 = SocketSearchClient(srv.host, srv.port)
+    qv = _qv(2)
+    result = {}
+    try:
+        hold.set()                        # connection warm-up passes
+        c2.topk_vectors(qv, k=10)
+        hold.clear()
+        entered.clear()                   # the warmup tripped it too
+
+        def inflight():
+            result["out"] = c1.topk_vectors(qv, k=10)
+
+        t1 = threading.Thread(target=inflight)
+        t1.start()
+        assert entered.wait(10.0)         # request 1 is mid-dispatch
+        closer = threading.Thread(target=lambda: srv.close(drain_s=10.0))
+        closer.start()
+        deadline = time.perf_counter() + 5.0
+        while not srv._draining and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        assert srv._draining
+        with pytest.raises(DeadlineExceeded, match="draining"):
+            c2.topk_vectors(qv, k=10)     # fresh request -> clean shed
+        hold.set()                        # let the in-flight one finish
+        t1.join(timeout=10.0)
+        closer.join(timeout=15.0)
+        s, i, _ = result["out"]           # ... and it answered normally
+        base_s, base_i = real_topk(qv, k=10)
+        assert np.array_equal(s, base_s) and np.array_equal(i, base_i)
+        assert svc.deadline_sheds >= 1 and svc._m_errors.value == 0
+        evs = [e for e in svc.registry.events()
+               if e["event"] == "deadline_shed"]
+        assert evs[-1]["attrs"]["reason"] == "draining"
+    finally:
+        hold.set()
+        svc.topk_vectors = real_topk
+        c1.close()
+        c2.close()
+        srv.close()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
 # loadgen over the wire + report-shape stability
 # ---------------------------------------------------------------------------
 
@@ -633,7 +960,14 @@ def test_span_tree_starts_at_socket_and_crosses_rpc_hop(net_store, mesh):
                                           gw.port, p, 2, 0, mesh))
         assert gw.wait_for_workers(2, timeout_s=30.0)
         client.topk_vectors(_qv(2), k=10)
-        trace = svc.tracer.last_trace()
+        # the client thread can observe its response a hair before the
+        # server coroutine exits the root span: poll, don't race
+        trace = None
+        for _ in range(200):
+            trace = svc.tracer.last_trace()
+            if trace is not None:
+                break
+            time.sleep(0.005)
         assert trace["name"] == "socket"
         assert trace["attrs"]["protocol"] == "vquery"
 
