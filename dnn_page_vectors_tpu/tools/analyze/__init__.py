@@ -3,11 +3,15 @@
     python -m dnn_page_vectors_tpu.cli lint            # JSON report, rc!=0
     python -m dnn_page_vectors_tpu.cli lint --write-baseline
 
-Five rule families turn the repo's load-bearing conventions into
+Nine rule families turn the repo's load-bearing conventions into
 machine-checked rules: determinism (seeded RNG / no wall clock on
-byte-pinned paths), lock discipline (`# guarded-by:` annotations), jit
-purity + host-sync hygiene, manifest-mediated file I/O, and doc/knob/
-marker drift. Stdlib-only: runs without jax installed.
+byte-pinned paths), lock discipline (`# guarded-by:` annotations),
+lock-order / deadlock analysis (`# lock-order:` hierarchy declarations),
+thread & resource lifecycle (join/daemon/close-on-error-path), asyncio
+hygiene (no blocking calls on the event loop), jit purity + host-sync
+hygiene, manifest-mediated file I/O, wire-protocol conformance (the DPV1
+frame table), and doc/knob/marker drift. Stdlib-only: runs without jax
+installed.
 """
 from dnn_page_vectors_tpu.tools.analyze.core import (  # noqa: F401
     BASELINE_NAME, REPO_ROOT, RULES, FileContext, Finding, ProjectContext,
@@ -15,6 +19,7 @@ from dnn_page_vectors_tpu.tools.analyze.core import (  # noqa: F401
 
 # importing the rule modules registers every rule with the registry
 from dnn_page_vectors_tpu.tools.analyze import (  # noqa: F401,E402
-    rules_determinism, rules_drift, rules_io, rules_jit, rules_locks)
+    rules_async, rules_determinism, rules_drift, rules_io, rules_jit,
+    rules_lifecycle, rules_lockorder, rules_locks, rules_proto)
 
 RULE_FAMILIES = sorted({r.family for r in RULES.values()})

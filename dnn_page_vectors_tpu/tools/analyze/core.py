@@ -122,7 +122,12 @@ def qualname(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
 
 
 class FileContext:
-    """One parsed source file handed to every in-scope file rule."""
+    """One parsed source file handed to every in-scope file rule.
+
+    Pragmas (and the comment tokens behind them) are parsed lazily on
+    first use: most files carry no `graftcheck:` marker at all, and the
+    tokenize pass is the expensive half of context construction — the
+    `cli lint --changed` fast path leans on skipping it."""
 
     def __init__(self, relpath: str, source: str):
         self.path = relpath.replace(os.sep, "/")
@@ -130,7 +135,24 @@ class FileContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source)
         self.aliases = _collect_aliases(self.tree)
-        self.pragmas = self._resolve_pragmas(parse_pragmas(source))
+        self._pragmas: Optional[List[Pragma]] = None
+        self._comments: Optional[List[Tuple[int, str]]] = None
+
+    @property
+    def comments(self) -> List[Tuple[int, str]]:
+        """(lineno, text) of every real COMMENT token in the file."""
+        if self._comments is None:
+            self._comments = ([] if "#" not in self.source
+                              else list(iter_comments(self.source)))
+        return self._comments
+
+    @property
+    def pragmas(self) -> List["Pragma"]:
+        if self._pragmas is None:
+            raw = (parse_pragmas(self.source)
+                   if "graftcheck:" in self.source else [])
+            self._pragmas = self._resolve_pragmas(raw)
+        return self._pragmas
 
     def _resolve_pragmas(self, raw: List["Pragma"]) -> List["Pragma"]:
         """Comment-only `off` pragmas above the first statement keep file
@@ -197,6 +219,17 @@ class FileContext:
         return frozenset(locks)
 
 
+def iter_comments(source: str) -> Iterator[Tuple[int, str]]:
+    """(lineno, text) for every COMMENT token; tolerant of half-written
+    fixtures the tokenizer chokes on."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError):
+        return
+
+
 def parse_pragmas(source: str) -> List[Pragma]:
     pragmas: List[Pragma] = []
     try:
@@ -229,6 +262,21 @@ class ProjectContext:
         self.root = root
         self.pkg = pkg
         self._cache: Dict[str, Optional[str]] = {}
+        self._fctx: Dict[str, Optional[FileContext]] = {}
+
+    def file_context(self, relpath: str) -> Optional[FileContext]:
+        """The shared parsed context for a package file (None when the
+        file is missing or unparsable — the `parse` finding belongs to
+        the runner). Project rules use this instead of re-parsing, so
+        one `analyze()` parses every file at most once."""
+        if relpath not in self._fctx:
+            src = self.read(relpath)
+            try:
+                self._fctx[relpath] = (None if src is None
+                                       else FileContext(relpath, src))
+            except SyntaxError:
+                self._fctx[relpath] = None
+        return self._fctx[relpath]
 
     def read(self, relpath: str) -> Optional[str]:
         if relpath not in self._cache:
@@ -395,11 +443,17 @@ def _pragma_findings(ctx_pragmas: Dict[str, List[Pragma]],
 def analyze(root: Optional[str] = None,
             rules: Optional[Iterable[str]] = None,
             baseline_path: Optional[str] = None,
-            pkg: str = PKG_NAME) -> Report:
+            pkg: str = PKG_NAME,
+            paths: Optional[Iterable[str]] = None) -> Report:
     """Run the registry over `<root>/<pkg>` plus the project-level rules.
 
     `rules` restricts to a subset of rule names (default: all). The
-    baseline defaults to `<root>/.graftcheck-baseline.json`.
+    baseline defaults to `<root>/.graftcheck-baseline.json`. `paths`
+    (repo-relative) restricts FILE-scoped rules to those files — the
+    `cli lint --changed` fast mode; project-level rules (the drift and
+    protocol contracts are whole-repo properties) still run everywhere,
+    and stale-baseline reporting is suppressed because unscanned files
+    cannot vouch for their entries.
     """
     root = root or REPO_ROOT
     if baseline_path is None:
@@ -410,6 +464,10 @@ def analyze(root: Optional[str] = None,
     raw: List[Finding] = []
     contexts: Dict[str, FileContext] = {}
     files = iter_package_files(root, pkg)
+    restricted = paths is not None
+    if restricted:
+        wanted = {str(p).replace(os.sep, "/") for p in paths}
+        files = [f for f in files if f in wanted]
     for rel in files:
         source = proj.read(rel)
         if source is None:
@@ -419,8 +477,10 @@ def analyze(root: Optional[str] = None,
         except SyntaxError as e:
             raw.append(Finding("parse", rel, e.lineno or 0, 0,
                                f"syntax error: {e.msg}"))
+            proj._fctx[rel] = None
             continue
         contexts[rel] = fctx
+        proj._fctx[rel] = fctx          # project rules reuse the parse
         for rule in active_rules:
             if rule.project or not rule.in_scope(rel):
                 continue
@@ -436,14 +496,12 @@ def analyze(root: Optional[str] = None,
         # project rules may land findings on files outside the package
         # sweep (tests/, config fixtures); parse their pragmas on demand
         if f.path not in pragmas_by_path and f.path.endswith(".py"):
-            src = proj.read(f.path)
-            if src is not None:
-                try:
-                    fc = FileContext(f.path, src)
-                    contexts[f.path] = fc
-                    pragmas_by_path[f.path] = fc.pragmas
-                except SyntaxError:
-                    pragmas_by_path[f.path] = []
+            fc = proj.file_context(f.path)
+            if fc is not None:
+                contexts[f.path] = fc
+                pragmas_by_path[f.path] = fc.pragmas
+            elif proj.read(f.path) is not None:
+                pragmas_by_path[f.path] = []
 
     raw.extend(_pragma_findings(pragmas_by_path, contexts))
 
@@ -468,7 +526,7 @@ def analyze(root: Optional[str] = None,
             baselined.append(f)
         else:
             final.append(f)
-    stale = sorted(set(baseline) - matched_keys)
+    stale = [] if restricted else sorted(set(baseline) - matched_keys)
 
     final.sort(key=lambda f: (f.path, f.line, f.rule))
     return Report(findings=final, suppressed=suppressed, baselined=baselined,

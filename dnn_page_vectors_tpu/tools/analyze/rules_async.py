@@ -1,0 +1,142 @@
+"""Rule family 8 — asyncio hygiene (docs/ANALYSIS.md).
+
+The front end (`infer/server.py`) frames every client connection on ONE
+event loop; a single blocking call inside an `async def` stalls every
+connection at once — the whole point of dispatching the device work to an
+executor evaporates, silently, and only under load. The loop-discipline
+contract, machine-checked:
+
+  * no blocking primitives on the loop: `time.sleep` (use
+    `asyncio.sleep`), blocking socket constructors/methods
+    (`socket.create_connection`, `.recv`/`.sendall`/`.accept`), bare
+    `open(...)` file I/O, or direct device pulls (`jax.device_get`,
+    `block_until_ready`) — device work belongs behind `run_in_executor`;
+  * every `create_task`/`ensure_future` result is stored or awaited — a
+    discarded task is garbage-collected mid-flight and its exceptions
+    vanish (the "fire and forget and lose" bug);
+  * no handler swallows `asyncio.CancelledError`: a bare `except:` (or
+    `except BaseException:`) without a re-raise eats the cancellation a
+    graceful shutdown depends on. `except Exception:` is fine —
+    CancelledError does not inherit from it.
+
+Sync helpers *called from* async code are out of scope here — they run on
+the executor; only the `async def` bodies themselves are the event loop's
+territory. Nested sync defs and lambdas inside an async function are
+skipped for the same reason (they are executor payloads).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    FileContext, Finding, Rule, qualname, register)
+
+_BLOCKING_CALLS = {
+    "time.sleep": "`time.sleep` blocks the event loop — "
+                  "`await asyncio.sleep(...)`",
+    "socket.create_connection": "blocking socket dial on the event loop "
+                                "— use asyncio.open_connection",
+    "socket.socketpair": "blocking socket setup on the event loop",
+    "socket.getaddrinfo": "blocking DNS resolution on the event loop — "
+                          "use loop.getaddrinfo",
+    "jax.device_get": "device pull on the event loop — dispatch through "
+                      "run_in_executor",
+    "jax.block_until_ready": "device sync on the event loop — dispatch "
+                             "through run_in_executor",
+}
+_BLOCKING_METHODS = {"recv", "recv_into", "sendall", "accept",
+                     "block_until_ready"}
+
+
+def _own_async_nodes(fn: ast.AsyncFunctionDef):
+    """Nodes belonging to this async def's own body — nested defs and
+    lambdas pruned (they execute elsewhere, usually on the executor)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class AsyncHygieneRule(Rule):
+    name = "async-hygiene"
+    family = "async"
+    doc = ("no blocking calls / file I/O / device pulls inside `async "
+           "def`; create_task results kept; no bare except swallowing "
+           "CancelledError")
+    scope = None          # any module may grow an async def
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield from self._check_async(ctx, node)
+
+    def _check_async(self, ctx: FileContext,
+                     fn: ast.AsyncFunctionDef) -> Iterator[Finding]:
+        for node in _own_async_nodes(fn):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, fn, node)
+            elif isinstance(node, ast.Expr) \
+                    and isinstance(node.value, ast.Call):
+                f = node.value.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("create_task", "ensure_future"):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"`{f.attr}` result discarded — the task can be "
+                        "garbage-collected mid-flight and its exception "
+                        "is lost; store the handle or await it")
+            elif isinstance(node, ast.ExceptHandler):
+                yield from self._check_handler(ctx, node)
+
+    def _check_call(self, ctx: FileContext, fn: ast.AsyncFunctionDef,
+                    call: ast.Call) -> Iterator[Finding]:
+        q = qualname(call.func, ctx.aliases)
+        if q in _BLOCKING_CALLS:
+            yield ctx.finding(
+                self.name, call,
+                f"{_BLOCKING_CALLS[q]} (inside `async def {fn.name}`)")
+        elif isinstance(call.func, ast.Name) and call.func.id == "open":
+            yield ctx.finding(
+                self.name, call,
+                f"file I/O on the event loop (inside `async def "
+                f"{fn.name}`) — run it on the executor")
+        elif (isinstance(call.func, ast.Attribute)
+              and call.func.attr in _BLOCKING_METHODS):
+            yield ctx.finding(
+                self.name, call,
+                f"blocking `.{call.func.attr}(...)` on the event loop "
+                f"(inside `async def {fn.name}`) — use the stream/"
+                "executor API")
+
+    def _check_handler(self, ctx: FileContext,
+                       handler: ast.ExceptHandler) -> Iterator[Finding]:
+        bare = handler.type is None
+        broad = self._names_base_exception(ctx, handler.type)
+        if not (bare or broad):
+            return
+        reraises = any(isinstance(n, ast.Raise) and n.exc is None
+                       for st in handler.body for n in ast.walk(st))
+        if reraises:
+            return
+        what = "bare `except:`" if bare else "`except BaseException:`"
+        yield ctx.finding(
+            self.name, handler,
+            f"{what} inside an async def swallows CancelledError — a "
+            "graceful shutdown can no longer cancel this coroutine; "
+            "catch `Exception` (CancelledError is not one) or re-raise")
+
+    @staticmethod
+    def _names_base_exception(ctx: FileContext,
+                              type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return False
+        nodes = (list(type_node.elts)
+                 if isinstance(type_node, ast.Tuple) else [type_node])
+        return any(qualname(n, ctx.aliases) == "BaseException"
+                   for n in nodes)

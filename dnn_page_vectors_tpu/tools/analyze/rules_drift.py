@@ -129,13 +129,34 @@ class EventDriftRule(Rule):
            "docs/OBSERVABILITY.md event table and vice versa")
     project = True
 
+    @staticmethod
+    def _event_rows(doc: str) -> Dict[str, int]:
+        """Backticked names from tables whose header's FIRST cell is
+        `event` — other tables in the doc (knobs, the shed-reason list
+        the proto-drift rule owns) are not event rows."""
+        out: Dict[str, int] = {}
+        in_event_table = False
+        for i, line in enumerate(doc.splitlines(), 1):
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                in_event_table = False
+                continue
+            cells = [c.strip() for c in stripped.strip("|").split("|")]
+            if cells and cells[0].lower() == "event":
+                in_event_table = True
+                continue
+            if not in_event_table:
+                continue
+            m = _EVENT_ROW_RE.match(stripped)
+            if m:
+                out.setdefault(m.group(1), i)
+        return out
+
     def check_project(self, ctx: ProjectContext) -> Iterator[Finding]:
         doc = ctx.read(_OBS_DOC)
         if doc is None:
             return
-        documented: Dict[str, int] = {}
-        for m in _EVENT_ROW_RE.finditer(doc):
-            documented.setdefault(m.group(1), _line_of(doc, m.start()))
+        documented = self._event_rows(doc)
         emitted: Dict[str, Tuple[str, int]] = {}
         tools_prefix = f"{ctx.pkg}/tools/"
         for rel in ctx.glob(ctx.pkg, ".py"):

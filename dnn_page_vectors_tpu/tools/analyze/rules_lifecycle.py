@@ -1,0 +1,345 @@
+"""Rule family 7 — thread & resource lifecycle (docs/ANALYSIS.md).
+
+The serving fleet spawns threads and opens sockets/executors on every
+connection, and the places leaks hide are exactly the paths tests rarely
+walk: the error path between an `open` and its `try`, the reader thread
+nobody joins, the socket a raised REGISTER leaves dangling. This rule
+makes the cleanup contract static:
+
+  * every `threading.Thread` STARTED must be daemonized (`daemon=True`
+    at construction or a `t.daemon = True` before start) or reachably
+    joined — locally (`t.join(...)` in the same function), or by the
+    owning class when the handle is stored on `self` (any method that
+    reads the attribute and joins);
+  * every socket / file / executor / `subprocess.Popen` opened must be
+    closed via a context manager, a `finally` the rule can reach, or an
+    ownership transfer (returned, stored on an object, passed onward —
+    whoever receives it is checked at ITS binding site);
+  * cleanup must cover the ERROR path: a `close()` that only runs on the
+    happy path is a finding, and so is a `try/finally` whose protected
+    resource was opened several call-bearing statements BEFORE the `try`
+    (anything raising in that window leaks the resource).
+
+Handles stored on `self.<attr>` are accepted when some method of the
+class reads the attribute and calls a closer (`close`/`shutdown`/
+`join`/`terminate`/...) — the `close()`-method idiom every service here
+uses.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    FileContext, Finding, Rule, qualname, register, PKG_NAME)
+
+_CREATORS = {
+    "threading.Thread": "thread",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "socket.socketpair": "socket",
+    "concurrent.futures.ThreadPoolExecutor": "executor",
+    "concurrent.futures.ProcessPoolExecutor": "executor",
+    "subprocess.Popen": "popen",
+}
+_CLOSERS = {"close", "shutdown", "stop", "terminate", "kill", "wait",
+            "join", "release"}
+_KIND_NOUN = {"thread": "thread", "socket": "socket", "file": "file",
+              "executor": "executor", "popen": "subprocess"}
+
+
+def _creator_kind(call: ast.Call, aliases) -> Optional[str]:
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return "file"
+    q = qualname(call.func, aliases)
+    return _CREATORS.get(q) if q else None
+
+
+def _kw_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _names_in(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+def _own_nodes(fn: ast.AST):
+    """Every node of `fn`'s body, nested function/lambda bodies pruned
+    (they are analyzed as their own functions)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class LifecycleRule(Rule):
+    name = "lifecycle"
+    family = "lifecycle"
+    doc = ("started threads must be daemonized or reachably joined; "
+           "sockets/files/executors/Popen must close via with/finally/"
+           "ownership, covering the error path")
+    scope = (f"{PKG_NAME}/infer/", f"{PKG_NAME}/maintenance/",
+             f"{PKG_NAME}/loadgen/", f"{PKG_NAME}/utils/telemetry.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls, fn in self._functions(ctx.tree):
+            yield from self._check_fn(ctx, cls, fn)
+
+    def _functions(self, tree: ast.Module):
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        yield node, sub
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield None, node
+                for sub in ast.walk(node):
+                    if sub is not node and isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        yield None, sub
+
+    # -- per function ------------------------------------------------------
+
+    def _check_fn(self, ctx: FileContext, cls: Optional[ast.ClassDef],
+                  fn: ast.AST) -> Iterator[Finding]:
+        finally_nodes = self._finally_nodes(fn)
+        locals_: List[Tuple[str, str, ast.Call, ast.stmt, list]] = []
+        for parent_list, st in self._own_stmts(fn):
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                continue            # context-managed: the gold standard
+            creators = [(n, _creator_kind(n, ctx.aliases))
+                        for n in ast.walk(st) if isinstance(n, ast.Call)]
+            creators = [(n, k) for n, k in creators if k]
+            if not creators:
+                continue
+            if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                target = st.targets[0]
+                bound = self._binds(st.value, creators)
+                if bound is not None and isinstance(target, ast.Name):
+                    locals_.append((target.id, bound[1], bound[0], st,
+                                    parent_list))
+                    continue
+                if bound is not None and self._is_self_attr(target):
+                    yield from self._check_self_attr(
+                        ctx, cls, target.attr, bound[1], bound[0])
+                    continue
+                if bound is not None and isinstance(target,
+                                                    ast.Attribute):
+                    continue        # stored on another object: theirs now
+            if isinstance(st, ast.Expr):
+                yield from self._check_dropped(ctx, st.value, creators)
+            # other shapes (return/yield/call-argument) transfer
+            # ownership to the receiver
+        for name, kind, call, st, parent_list in locals_:
+            yield from self._check_local(ctx, fn, finally_nodes, name,
+                                         kind, call, st, parent_list)
+
+    def _own_stmts(self, fn: ast.AST):
+        """(parent statement list, statement) pairs, nested defs pruned."""
+        stack = [fn.body]
+        while stack:
+            body = stack.pop()
+            for st in body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                yield body, st
+                for _, val in ast.iter_fields(st):
+                    if isinstance(val, list) and val \
+                            and isinstance(val[0], ast.stmt):
+                        stack.append(val)
+                    elif isinstance(val, list):
+                        for v in val:
+                            sub = getattr(v, "body", None)
+                            if (isinstance(sub, list) and sub
+                                    and isinstance(sub[0], ast.stmt)):
+                                stack.append(sub)
+
+    @staticmethod
+    def _is_self_attr(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    @staticmethod
+    def _binds(value: ast.AST, creators) -> Optional[Tuple[ast.Call, str]]:
+        """The creator call a simple assignment binds: the value itself,
+        an IfExp/BoolOp arm, or a comprehension element. A creator buried
+        as another call's ARGUMENT is not bound here (the receiver owns
+        it)."""
+        heads = [value]
+        if isinstance(value, ast.IfExp):
+            heads = [value.body, value.orelse]
+        elif isinstance(value, ast.BoolOp):
+            heads = list(value.values)
+        elif isinstance(value, (ast.ListComp, ast.SetComp,
+                                ast.GeneratorExp)):
+            heads = [value.elt]
+        for call, kind in creators:
+            if call in heads:
+                return call, kind
+        return None
+
+    def _finally_nodes(self, fn: ast.AST) -> Set[int]:
+        out: Set[int] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Try):
+                for st in node.finalbody:
+                    for sub in ast.walk(st):
+                        out.add(id(sub))
+        return out
+
+    # -- the three ownership shapes ---------------------------------------
+
+    def _check_dropped(self, ctx: FileContext, value: ast.AST,
+                       creators) -> Iterator[Finding]:
+        for call, kind in creators:
+            if kind == "thread":
+                if not _kw_true(call, "daemon"):
+                    yield ctx.finding(
+                        self.name, call,
+                        "thread constructed and dropped — pass "
+                        "`daemon=True` or keep the handle and join it")
+            elif value is call or (isinstance(value, ast.Call)
+                                   and call in ast.walk(value.func)):
+                yield ctx.finding(
+                    self.name, call,
+                    f"{_KIND_NOUN[kind]} opened and dropped — nothing "
+                    "can ever close it; bind it and close in a finally")
+
+    def _check_self_attr(self, ctx: FileContext,
+                         cls: Optional[ast.ClassDef], attr: str,
+                         kind: str, call: ast.Call) -> Iterator[Finding]:
+        if kind == "thread" and _kw_true(call, "daemon"):
+            return
+        if cls is not None and self._class_cleans(cls, attr, kind):
+            return
+        want = "join" if kind == "thread" else "close/shutdown"
+        yield ctx.finding(
+            self.name, call,
+            f"`self.{attr}` holds a {_KIND_NOUN[kind]} but no method of "
+            f"{cls.name if cls else 'this class'} reads it and calls "
+            f"{want} — leaked on shutdown"
+            + (" (or pass daemon=True)" if kind == "thread" else ""))
+
+    def _class_cleans(self, cls: ast.ClassDef, attr: str,
+                      kind: str) -> bool:
+        closers = {"join"} if kind == "thread" else _CLOSERS
+        for fn in ast.walk(cls):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            reads = any(
+                isinstance(n, ast.Attribute) and n.attr == attr
+                and isinstance(n.value, ast.Name) and n.value.id == "self"
+                for n in ast.walk(fn))
+            closes = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in closers
+                for n in ast.walk(fn))
+            if reads and closes:
+                return True
+        return False
+
+    def _check_local(self, ctx: FileContext, fn: ast.AST,
+                     finally_nodes: Set[int], name: str, kind: str,
+                     call: ast.Call, st: ast.stmt,
+                     parent_list: list) -> Iterator[Finding]:
+        closes: List[ast.Call] = []
+        started = daemon = escapes = False
+        if kind == "thread" and _kw_true(call, "daemon"):
+            daemon = True
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == name):
+                    if node.func.attr in _CLOSERS:
+                        closes.append(node)
+                    if node.func.attr == "start":
+                        started = True
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if _names_in(arg, name):
+                        escapes = True
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None and _names_in(node.value, name):
+                    escapes = True
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                            and _names_in(node.value, name):
+                        escapes = True
+                    if (isinstance(t, ast.Attribute) and t.attr == "daemon"
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == name
+                            and isinstance(node.value, ast.Constant)
+                            and node.value.value):
+                        daemon = True
+
+        if kind == "thread":
+            joined = any(c.func.attr == "join" for c in closes)
+            if started and not daemon and not joined and not escapes:
+                yield ctx.finding(
+                    self.name, call,
+                    f"thread `{name}` is started but neither daemonized "
+                    "nor joined — a non-daemon leak keeps the process "
+                    "alive; join it (or pass daemon=True)")
+            return
+
+        strong = [c for c in closes if id(c) in finally_nodes]
+        if strong:
+            yield from self._check_window(ctx, name, kind, st,
+                                          parent_list)
+        elif escapes:
+            return                  # ownership transferred
+        elif closes:
+            yield ctx.finding(
+                self.name, closes[0],
+                f"`{name}.{closes[0].func.attr}()` runs only on the "
+                "happy path — anything raising before it leaks the "
+                f"{_KIND_NOUN[kind]}; use `with` or a finally")
+        else:
+            yield ctx.finding(
+                self.name, call,
+                f"{_KIND_NOUN[kind]} `{name}` is opened and never "
+                "closed on any path — use `with`, a finally, or hand "
+                "it to an owner that closes it")
+
+    def _check_window(self, ctx: FileContext, name: str, kind: str,
+                      st: ast.stmt, parent_list: list) -> Iterator[Finding]:
+        """The creation is closed in a finally: make sure nothing that
+        can raise runs between the creation and the protecting try."""
+        try:
+            idx = parent_list.index(st)
+        except ValueError:
+            return
+        for later in parent_list[idx + 1:]:
+            if isinstance(later, ast.Try):
+                closed_here = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _CLOSERS
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == name
+                    for f in later.finalbody for n in ast.walk(f))
+                if closed_here:
+                    return
+            if any(isinstance(n, ast.Call) for n in ast.walk(later)):
+                yield ctx.finding(
+                    self.name, later,
+                    f"statement between `{name} = ...` and its "
+                    "try/finally can raise and leak the "
+                    f"{_KIND_NOUN[kind]} — open inside the try (or "
+                    "close on this error path)")
+                return

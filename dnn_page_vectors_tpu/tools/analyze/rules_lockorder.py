@@ -1,0 +1,426 @@
+"""Rule family 6 — lock-order / deadlock analysis (docs/ANALYSIS.md).
+
+The serving fleet holds locks in layers: the gateway registry lock over
+per-worker connection locks, the maintenance mutation RLock over the
+service stats lock, the refresh lock over the view-build path. A deadlock
+needs two threads acquiring the same two locks in opposite orders — a
+property no test reliably provokes (the windows are microseconds) but a
+static scan proves absent: build the project-wide lock acquisition graph
+and any cycle is a potential deadlock.
+
+Edges come from three places:
+
+  * **nested `with`** — `with self._a:` enclosing `with self._b:` is an
+    a -> b edge;
+  * **call closure** — a method that CALLS another method while holding a
+    lock inherits every lock the callee (transitively, through same-class
+    calls and imported package-level functions) acquires;
+  * **`# holds-lock: X`** — the annotated helper's body is scanned as if
+    X were held, so the caller-holds-lock contract feeds the graph too.
+
+A cycle is reported ONCE with every edge's acquisition path (file:line +
+how the second lock is reached), so the finding shows both sides of the
+race. A self-edge on a plain `threading.Lock` is a self-deadlock and
+reported; on an `RLock` it is re-entry and fine.
+
+The intended hierarchy is pinned in source with order declarations:
+
+    # lock-order: MaintenanceService._mlock < MaintenanceService._lock
+
+(chains allowed: `A < B < C`). The rule validates every declaration —
+names must be locks that exist, two declarations must not contradict each
+other, and an OBSERVED edge against the declared order is a finding even
+before it closes a cycle. Lock nodes are named `Class.attr` for instance
+locks and `module.NAME` for module-level locks.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    FileContext, Finding, ProjectContext, Rule, qualname, register)
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+}
+
+_DECL_RE = re.compile(r"#\s*lock-order:\s*(\S.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class _Edge:
+    """One observed `a` held while `b` is acquired, with its witness."""
+    a: str
+    b: str
+    path: str
+    line: int
+    how: str              # human acquisition-path fragment
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    """Per-function lock facts feeding the cross-function closure."""
+    key: Tuple[str, Optional[str], str]           # (path, class, name)
+    acquires: Dict[str, Tuple[str, int]]          # lock -> first (path, ln)
+    edges: List[_Edge]
+    # (callee key or dotted name, locks held at the call site, line)
+    calls: List[Tuple[object, frozenset, int]]
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _Graph:
+    """The project-wide acquisition graph under construction."""
+
+    def __init__(self):
+        self.locks: Dict[str, str] = {}          # node -> Lock/RLock/...
+        self.fns: Dict[Tuple, _FnInfo] = {}
+        self.attr_owner: Dict[str, Optional[str]] = {}  # lockattr -> class
+        self.edges: Dict[Tuple[str, str], _Edge] = {}
+
+    def add_lock(self, node: str, kind: str, attr: Optional[str],
+                 owner: Optional[str]) -> None:
+        self.locks[node] = kind
+        if attr is not None:
+            # `with worker.wlock:` resolves through attr uniqueness: when
+            # exactly ONE class in the project declares the attr, a
+            # non-self acquisition still lands on the right node
+            if attr in self.attr_owner and self.attr_owner[attr] != owner:
+                self.attr_owner[attr] = None     # ambiguous: never resolve
+            else:
+                self.attr_owner.setdefault(attr, owner)
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    family = "lock-order"
+    doc = ("cycles in the project-wide lock acquisition graph (nested "
+           "`with` + call closure + `# holds-lock:`); `# lock-order:` "
+           "declarations validated against observed acquisitions")
+    project = True
+
+    # -- harvesting --------------------------------------------------------
+
+    def check_project(self, ctx: ProjectContext) -> Iterator[Finding]:
+        graph = _Graph()
+        contexts: Dict[str, FileContext] = {}
+        decls: List[Tuple[str, int, List[str]]] = []
+        for rel in ctx.glob(ctx.pkg, ".py"):
+            fctx = ctx.file_context(rel)
+            if fctx is None:
+                continue          # the parse rule owns broken files
+            contexts[rel] = fctx
+            self._harvest_locks(graph, fctx)
+            if rel.startswith(f"{ctx.pkg}/tools/"):
+                continue          # the analyzer quotes its own grammar
+            if "lock-order:" not in fctx.source:
+                continue          # skip the tokenize pass entirely
+            # real COMMENT tokens only — a docstring quoting the
+            # declaration grammar is prose, not a declaration
+            for line, text in fctx.comments:
+                m = _DECL_RE.search(text)
+                if m:
+                    chain = [t.strip().strip("`")
+                             for t in m.group(1).split("<")]
+                    decls.append((rel, line, [t for t in chain if t]))
+        for fctx in contexts.values():
+            self._harvest_fns(graph, fctx, contexts)
+        self._close_calls(graph)
+        yield from self._report_cycles(graph)
+        yield from self._check_decls(ctx, graph, decls)
+
+    def _harvest_locks(self, graph: _Graph, fctx: FileContext) -> None:
+        for node in fctx.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                kind = _LOCK_CTORS.get(
+                    qualname(node.value.func, fctx.aliases) or "")
+                if kind and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    mod = fctx.path.rsplit("/", 1)[-1][:-3]
+                    graph.add_lock(f"{mod}.{node.targets[0].id}", kind,
+                                   None, None)
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Assign)
+                        and isinstance(sub.value, ast.Call)):
+                    continue
+                kind = _LOCK_CTORS.get(
+                    qualname(sub.value.func, fctx.aliases) or "")
+                attr = (_self_attr(sub.targets[0])
+                        if kind and len(sub.targets) == 1 else None)
+                if attr:
+                    graph.add_lock(f"{node.name}.{attr}", kind, attr,
+                                   node.name)
+
+    def _harvest_fns(self, graph: _Graph, fctx: FileContext,
+                     contexts: Dict[str, FileContext]) -> None:
+        for node in fctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for fn in node.body:
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        self._harvest_one(graph, fctx, node.name, fn)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._harvest_one(graph, fctx, None, node)
+
+    def _harvest_one(self, graph: _Graph, fctx: FileContext,
+                     cls: Optional[str],
+                     fn: ast.AST) -> None:
+        key = (fctx.path, cls, fn.name)
+        info = _FnInfo(key, {}, [], [])
+        graph.fns[key] = info
+        held = frozenset(
+            f"{cls}.{name}" for name in fctx.holds_lock(fn)
+            if cls and f"{cls}.{name}" in graph.locks)
+        self._walk(graph, fctx, cls, info, fn.body, held)
+
+    def _resolve_lock(self, graph: _Graph, fctx: FileContext,
+                      cls: Optional[str], expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None:
+            node = f"{cls}.{attr}" if cls else None
+            if node in graph.locks:
+                return node
+            return None
+        if isinstance(expr, ast.Name):
+            mod = fctx.path.rsplit("/", 1)[-1][:-3]
+            node = f"{mod}.{expr.id}"
+            return node if node in graph.locks else None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name):
+            owner = graph.attr_owner.get(expr.attr)
+            if owner:
+                return f"{owner}.{expr.attr}"
+        return None
+
+    def _walk(self, graph: _Graph, fctx: FileContext, cls: Optional[str],
+              info: _FnInfo, stmts, held: frozenset) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                got = []
+                for item in st.items:
+                    lock = self._resolve_lock(graph, fctx, cls,
+                                              item.context_expr)
+                    if lock is None:
+                        self._exprs(graph, fctx, cls, info,
+                                    item.context_expr, held)
+                        continue
+                    got.append(lock)
+                    info.acquires.setdefault(lock, (fctx.path, st.lineno))
+                    for h in held:
+                        info.edges.append(_Edge(
+                            h, lock, fctx.path, st.lineno,
+                            f"`{lock}` acquired with `{h}` held"))
+                self._walk(graph, fctx, cls, info, st.body,
+                           held | frozenset(got))
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs later on an unknown thread: no locks
+                # inherited, but its own nestings still feed the graph
+                self._walk(graph, fctx, cls, info, st.body, frozenset())
+            elif isinstance(st, ast.ClassDef):
+                continue
+            else:
+                # header expressions see the current held set; child
+                # statement bodies recurse so nested `with` blocks extend
+                # it and nested defs reset it
+                body_lists = []
+                for _, val in ast.iter_fields(st):
+                    if isinstance(val, list) and val \
+                            and isinstance(val[0], ast.stmt):
+                        body_lists.append(val)
+                    elif isinstance(val, list):
+                        for v in val:
+                            sub = getattr(v, "body", None)
+                            if (isinstance(sub, list) and sub
+                                    and isinstance(sub[0], ast.stmt)):
+                                body_lists.append(sub)
+                            elif isinstance(v, ast.AST):
+                                self._exprs(graph, fctx, cls, info, v,
+                                            held)
+                    elif isinstance(val, ast.AST):
+                        self._exprs(graph, fctx, cls, info, val, held)
+                for body in body_lists:
+                    self._walk(graph, fctx, cls, info, body, held)
+
+    def _exprs(self, graph: _Graph, fctx: FileContext, cls: Optional[str],
+               info: _FnInfo, tree: ast.AST, held: frozenset) -> None:
+        """Note every call in an expression subtree, without descending
+        into nested function/lambda bodies (those run with no inherited
+        locks and are scanned by their own `_walk` when they are defs)."""
+        stack = [tree]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                self._note_call(graph, fctx, cls, info, node, held)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _note_call(self, graph: _Graph, fctx: FileContext,
+                   cls: Optional[str], info: _FnInfo, call: ast.Call,
+                   held: frozenset) -> None:
+        callee = _self_attr(call.func)
+        if callee is not None and cls is not None:
+            info.calls.append(((fctx.path, cls, callee), held,
+                               call.lineno))
+            return
+        q = qualname(call.func, fctx.aliases)
+        if q and "." in q:
+            info.calls.append((q, held, call.lineno))
+
+    # -- closure -----------------------------------------------------------
+
+    def _close_calls(self, graph: _Graph) -> None:
+        """Propagate transitive acquisitions through the call graph, then
+        materialize call-closure edges for every lock-holding call site."""
+        by_dotted: Dict[str, Tuple] = {}
+        for (path, cls, name) in graph.fns:
+            if cls is None:
+                mod = path[:-3].replace("/", ".")
+                by_dotted[f"{mod}.{name}"] = (path, cls, name)
+                # `from pkg.mod import f` aliases resolve without the
+                # package prefix too
+                parts = mod.split(".")
+                for i in range(1, len(parts)):
+                    by_dotted[".".join(parts[i:]) + f".{name}"] = (
+                        path, cls, name)
+
+        memo: Dict[Tuple, Dict[str, Tuple[str, int]]] = {}
+
+        def closure(key, stack=()):
+            if key in memo:
+                return memo[key]
+            if key in stack or key not in graph.fns:
+                return {}
+            info = graph.fns[key]
+            out = dict(info.acquires)
+            for callee, _, _ in info.calls:
+                ck = callee if isinstance(callee, tuple) \
+                    else by_dotted.get(callee)
+                if ck is None or ck not in graph.fns:
+                    continue
+                for lock, wit in closure(ck, stack + (key,)).items():
+                    out.setdefault(lock, wit)
+            memo[key] = out
+            return out
+
+        for key, info in graph.fns.items():
+            for e in info.edges:
+                graph.edges.setdefault((e.a, e.b), e)
+            for callee, held, line in info.calls:
+                if not held:
+                    continue
+                ck = callee if isinstance(callee, tuple) \
+                    else by_dotted.get(callee)
+                if ck is None or ck not in graph.fns:
+                    continue
+                cname = ck[2] if isinstance(ck, tuple) else callee
+                for lock, (wpath, wline) in closure(ck).items():
+                    for h in held:
+                        graph.edges.setdefault((h, lock), _Edge(
+                            h, lock, key[0], line,
+                            f"call to {cname}() acquires `{lock}` "
+                            f"(at {wpath}:{wline}) with `{h}` held"))
+
+    # -- reporting ---------------------------------------------------------
+
+    def _report_cycles(self, graph: _Graph) -> Iterator[Finding]:
+        adj: Dict[str, List[str]] = {}
+        for (a, b), _ in sorted(graph.edges.items()):
+            if a == b:
+                if graph.locks.get(a) != "RLock":
+                    e = graph.edges[(a, b)]
+                    yield Finding(
+                        self.name, e.path, e.line, 0,
+                        f"self-deadlock: `{a}` (a non-reentrant "
+                        f"{graph.locks.get(a, 'Lock')}) is re-acquired "
+                        f"while already held — {e.how} "
+                        f"(at {e.path}:{e.line})",
+                        "")
+                continue
+            adj.setdefault(a, []).append(b)
+
+        seen_cycles: Set[frozenset] = set()
+        for start in sorted(adj):
+            cycle = self._find_cycle(adj, start)
+            if cycle is None:
+                continue
+            key = frozenset(cycle)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            witnesses = "; ".join(
+                f"{graph.edges[p].path}:{graph.edges[p].line}: "
+                f"{graph.edges[p].how}" for p in pairs)
+            first = graph.edges[pairs[0]]
+            chain = " -> ".join(f"`{n}`" for n in cycle + [cycle[0]])
+            yield Finding(
+                self.name, first.path, first.line, 0,
+                f"potential deadlock: lock cycle {chain}; acquisition "
+                f"paths: {witnesses}", "")
+
+    def _find_cycle(self, adj: Dict[str, List[str]],
+                    start: str) -> Optional[List[str]]:
+        """A simple cycle through `start`, as the node list, or None."""
+        stack = [(start, [start])]
+        visited: Set[str] = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start:
+                    return path
+                if nxt in visited or nxt in path:
+                    continue
+                visited.add(nxt)
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def _check_decls(self, ctx: ProjectContext, graph: _Graph,
+                     decls: List[Tuple[str, int, List[str]]]
+                     ) -> Iterator[Finding]:
+        pairs: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for rel, line, chain in decls:
+            for tok in chain:
+                if tok not in graph.locks:
+                    yield ctx.finding(
+                        self.name, rel, line,
+                        f"lock-order declaration names `{tok}` but no "
+                        "such lock exists (nodes are `Class.attr` / "
+                        "`module.NAME`) — stale declaration")
+            known = [t for t in chain if t in graph.locks]
+            for i, a in enumerate(known):
+                for b in known[i + 1:]:
+                    pairs.setdefault((a, b), (rel, line))
+        for (a, b), (rel, line) in sorted(pairs.items()):
+            if (b, a) in pairs:
+                other = pairs[(b, a)]
+                if (a, b) < (b, a):   # report each contradiction once
+                    yield ctx.finding(
+                        self.name, rel, line,
+                        f"contradictory lock-order declarations: "
+                        f"`{a}` < `{b}` here but `{b}` < `{a}` at "
+                        f"{other[0]}:{other[1]}")
+            e = graph.edges.get((b, a))
+            if e is not None:
+                yield ctx.finding(
+                    self.name, e.path, e.line,
+                    f"acquisition order violates the declared hierarchy "
+                    f"`{a}` < `{b}` ({rel}:{line}): {e.how} "
+                    f"(at {e.path}:{e.line})")

@@ -1,0 +1,278 @@
+"""Rule family 9 — wire-protocol conformance (docs/ANALYSIS.md).
+
+The `DPV1` framing lives in exactly one module (`infer/transport.py`) and
+exactly one doc (the docs/SERVING.md frame-layout table, plus the shed-
+reason list in docs/OBSERVABILITY.md). Peers are written against the DOC;
+the fleet runs the CODE — drift between them is a wire bug someone else
+debugs months later. Same contract style as `drift-knobs`/`drift-events`:
+both directions, machine-checked.
+
+  * every `T_<NAME>` frame-type constant has a `NAME` row in the
+    SERVING.md frame-layout table, and every row names a constant that
+    exists (several names may share one row: "`HEARTBEAT` / `BYE`");
+  * every `T_*` constant is registered in `_TYPES` (a type missing there
+    is dead on arrival — `_check_header` rejects it at the socket);
+  * every frame type has a bounded-length decode branch: a
+    `decode_<name>` function, or an explicit `T_<NAME>` dispatch inside
+    some `decode_*` function — EXCEPT types whose documented payload is
+    literally `empty`;
+  * every `decode_*` function guards its reads — a `len(...)` check, an
+    exact-size `Struct.unpack`, or pure dispatch to other decoders — so
+    a truncated payload can never index past the buffer silently;
+  * every `FLAG_*` capability constant appears (backticked) in
+    SERVING.md and vice versa;
+  * every shed-reason string passed to `_shed_deadline("...")` anywhere
+    in the package appears in the OBSERVABILITY.md "Shed reasons" table
+    and vice versa.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, Set, Tuple
+
+from dnn_page_vectors_tpu.tools.analyze.core import (
+    Finding, ProjectContext, Rule, register, PKG_NAME)
+
+_TRANSPORT = f"{PKG_NAME}/infer/transport.py"
+_SERVING_DOC = "docs/SERVING.md"
+_OBS_DOC = "docs/OBSERVABILITY.md"
+
+_ROW_NAME_RE = re.compile(r"`([A-Z][A-Z_0-9]*)`")
+_FLAG_DOC_RE = re.compile(r"`(FLAG_[A-Z_0-9]+)`")
+_REASON_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z_0-9]*)`")
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+class _Transport:
+    """AST facts about infer/transport.py."""
+
+    def __init__(self, tree: ast.Module):
+        self.types: Dict[str, int] = {}       # T_NAME -> lineno
+        self.flags: Dict[str, int] = {}       # FLAG_NAME -> lineno
+        self.registered: Set[str] = set()     # names inside _TYPES
+        self.decoders: Dict[str, ast.FunctionDef] = {}
+        self.dispatched: Set[str] = set()     # T_ names used in decode_*
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name.startswith("T_") and isinstance(node.value,
+                                                        ast.Constant):
+                    self.types[name] = node.lineno
+                elif name.startswith("FLAG_"):
+                    self.flags[name] = node.lineno
+                elif name == "_TYPES":
+                    for n in ast.walk(node.value):
+                        if isinstance(n, ast.Name) \
+                                and n.id.startswith("T_"):
+                            self.registered.add(n.id)
+            elif isinstance(node, ast.FunctionDef) \
+                    and node.name.startswith("decode_"):
+                self.decoders[node.name] = node
+                for n in ast.walk(node):
+                    if isinstance(n, ast.Name) and n.id.startswith("T_"):
+                        self.dispatched.add(n.id)
+
+    def decoder_guarded(self, fn: ast.FunctionDef) -> bool:
+        """A length guard: a len() call, an exact-size .unpack(...), or
+        pure dispatch to other decode_* functions."""
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Name) and n.func.id == "len":
+                return True
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "unpack":
+                return True
+            if isinstance(n.func, ast.Name) \
+                    and n.func.id.startswith("decode_"):
+                return True
+        return False
+
+
+def _frame_table(doc: str) -> Dict[str, Tuple[int, str]]:
+    """SERVING.md frame rows: NAME -> (lineno, payload cell text). Rows
+    whose first cell carries several backticked ALL-CAPS names document
+    each of them (the `HEARTBEAT` / `BYE` row)."""
+    out: Dict[str, Tuple[int, str]] = {}
+    for i, line in enumerate(doc.splitlines(), 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) < 2:
+            continue
+        payload = cells[1]
+        for m in _ROW_NAME_RE.finditer(cells[0]):
+            out.setdefault(m.group(1), (i, payload))
+    return out
+
+
+def _reason_table(doc: str) -> Dict[str, int]:
+    """The OBSERVABILITY.md "Shed reasons" table: reason -> lineno."""
+    lines = doc.splitlines()
+    out: Dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(lines, 1):
+        if line.startswith("#") and "Shed reasons" in line:
+            in_section = True
+            continue
+        if in_section and line.startswith("#"):
+            break
+        if in_section:
+            m = _REASON_ROW_RE.match(line)
+            if m and m.group(1) != "reason":
+                out.setdefault(m.group(1), i)
+    return out
+
+
+@register
+class ProtoDriftRule(Rule):
+    name = "proto-drift"
+    family = "proto"
+    doc = ("transport.py frame-type constants / capability flags / shed "
+           "reasons match the docs/SERVING.md frame table and "
+           "docs/OBSERVABILITY.md reason list both ways; every frame "
+           "type decodes bounded")
+    project = True
+
+    def check_project(self, ctx: ProjectContext) -> Iterator[Finding]:
+        fctx = ctx.file_context(_TRANSPORT)
+        if fctx is None:
+            return                # missing/broken: the parse rule owns it
+        tp = _Transport(fctx.tree)
+        serving = ctx.read(_SERVING_DOC)
+        if serving is not None:
+            yield from self._check_frames(ctx, tp, serving)
+            yield from self._check_flags(ctx, tp, serving)
+        yield from self._check_decoders(ctx, tp,
+                                        serving if serving else "")
+        yield from self._check_reasons(ctx)
+
+    # -- frame table, both ways -------------------------------------------
+
+    def _check_frames(self, ctx: ProjectContext, tp: _Transport,
+                      serving: str) -> Iterator[Finding]:
+        table = _frame_table(serving)
+        for const, line in sorted(tp.types.items()):
+            name = const[2:]
+            if name not in table:
+                yield ctx.finding(
+                    self.name, _TRANSPORT, line,
+                    f"frame type `{const}` has no row in the "
+                    f"{_SERVING_DOC} frame-layout table — peers are "
+                    "written against the doc; document the layout")
+            if const not in tp.registered:
+                yield ctx.finding(
+                    self.name, _TRANSPORT, line,
+                    f"frame type `{const}` is not registered in `_TYPES`"
+                    " — _check_header REJECTS it at the socket, the "
+                    "type is dead on arrival")
+        for name, (line, _) in sorted(table.items()):
+            if f"T_{name}" not in tp.types:
+                yield ctx.finding(
+                    self.name, _SERVING_DOC, line,
+                    f"frame row `{name}` documents no transport.py "
+                    f"constant (`T_{name}` missing) — stale table row")
+        for const in sorted(tp.registered - set(tp.types)):
+            yield ctx.finding(
+                self.name, _TRANSPORT, 1,
+                f"`_TYPES` registers `{const}` but no such constant is "
+                "defined")
+
+    # -- decode coverage ---------------------------------------------------
+
+    def _check_decoders(self, ctx: ProjectContext, tp: _Transport,
+                        serving: str) -> Iterator[Finding]:
+        table = _frame_table(serving)
+        for const, line in sorted(tp.types.items()):
+            name = const[2:]
+            payload = (table.get(name) or (0, ""))[1].strip().lower()
+            if payload == "empty":
+                continue          # nothing to decode, nothing to bound
+            if f"decode_{name.lower()}" in tp.decoders:
+                continue
+            if const in tp.dispatched:
+                continue          # handled by a decode_*_any dispatcher
+            yield ctx.finding(
+                self.name, _TRANSPORT, line,
+                f"frame type `{const}` has no bounded-length decode "
+                f"branch (no `decode_{name.lower()}` and no dispatch in "
+                "any decode_* function) — an undecodable frame hangs "
+                "protocol evolution on the receiver")
+        for fname, fn in sorted(tp.decoders.items()):
+            if not tp.decoder_guarded(fn):
+                yield ctx.finding(
+                    self.name, _TRANSPORT, fn.lineno,
+                    f"decoder `{fname}` has no length guard (no len() "
+                    "check, exact-size unpack, or decode_* dispatch) — "
+                    "a truncated payload can read past the buffer")
+
+    # -- capability flags, both ways --------------------------------------
+
+    def _check_flags(self, ctx: ProjectContext, tp: _Transport,
+                     serving: str) -> Iterator[Finding]:
+        documented = {m.group(1): _line_of(serving, m.start())
+                      for m in _FLAG_DOC_RE.finditer(serving)}
+        for flag, line in sorted(tp.flags.items()):
+            if flag not in documented:
+                yield ctx.finding(
+                    self.name, _TRANSPORT, line,
+                    f"capability flag `{flag}` is not documented in "
+                    f"{_SERVING_DOC} — negotiation bits are wire "
+                    "contract, document the capability")
+        for flag, line in sorted(documented.items()):
+            if flag not in tp.flags:
+                yield ctx.finding(
+                    self.name, _SERVING_DOC, line,
+                    f"doc names capability flag `{flag}` but "
+                    f"transport.py defines no such constant — stale")
+
+    # -- shed reasons, both ways ------------------------------------------
+
+    def _check_reasons(self, ctx: ProjectContext) -> Iterator[Finding]:
+        emitted: Dict[str, Tuple[str, int]] = {}
+        for rel in ctx.glob(ctx.pkg, ".py"):
+            if rel.startswith(f"{ctx.pkg}/tools/"):
+                continue          # the analyzer quotes what it hunts
+            fctx = ctx.file_context(rel)
+            if fctx is None or "_shed_deadline" not in fctx.source:
+                continue
+            for node in ast.walk(fctx.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "_shed_deadline"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    emitted.setdefault(node.args[0].value,
+                                       (rel, node.lineno))
+        if not emitted:
+            return
+        doc = ctx.read(_OBS_DOC)
+        if doc is None:
+            return
+        documented = _reason_table(doc)
+        if not documented:
+            yield ctx.finding(
+                self.name, _OBS_DOC, 1,
+                f"{_OBS_DOC} has no \"Shed reasons\" table while the "
+                f"package sheds with {len(emitted)} distinct reasons — "
+                "add the table (docs/ANALYSIS.md `proto-drift`)")
+            return
+        for reason, (rel, line) in sorted(emitted.items()):
+            if reason not in documented:
+                yield ctx.finding(
+                    self.name, rel, line,
+                    f"shed reason `{reason}` is emitted here but "
+                    f"missing from the {_OBS_DOC} \"Shed reasons\" "
+                    "table")
+        for reason, line in sorted(documented.items()):
+            if reason not in emitted:
+                yield ctx.finding(
+                    self.name, _OBS_DOC, line,
+                    f"shed reason `{reason}` is documented but nothing "
+                    "sheds with it — dead table row")
