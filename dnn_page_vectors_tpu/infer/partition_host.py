@@ -179,6 +179,10 @@ class WorkerGateway:
                                                  partitions=1, replicas=1)
         self.partition_set = pset
         self._lock = threading.Lock()
+        # registry lock over per-worker connection state: stats() reads
+        # worker liveness (the _WorkerConn._lock property) while holding
+        # the registry lock, never the reverse (graftcheck lock-order)
+        # lock-order: WorkerGateway._lock < _WorkerConn._lock
         self._workers: Dict[Tuple[int, int], _WorkerConn] = {}  # guarded-by: _lock
         self._pending: Dict[int, Tuple[Future, _WorkerConn]] = {}  # guarded-by: _lock
         self._lat: Dict[int, LatencyStats] = {}   # guarded-by: _lock
@@ -792,20 +796,27 @@ class PartitionWorker:
         """Connect, register, serve until the gateway closes the
         connection (or stop()). Blocking — the process entry point."""
         sock = socket.create_connection(self.connect)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
-        with self._wlock:
-            self._sender = FrameSender(sock)
-        transport.write_frame(sock, T_REGISTER, transport.encode_register(
-            self.partition, self.replica, os.getpid(),
-            flags=FLAG_WIRE_COMPRESS if self.wire_compress else 0,
-            generation=self.view.generation))
-        hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
-                              name=f"worker-p{self.partition}"
-                                   f"r{self.replica}-hb")
-        hb.start()
+        hb: Optional[threading.Thread] = None
         slots: Dict[int, bytes] = {}   # per-connection intern table
+        # everything past the dial runs inside the try: an OSError on
+        # setsockopt or the REGISTER write must close the socket on its
+        # way out, not leak it (graftcheck lifecycle rule)
         try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._wlock:
+                self._sender = FrameSender(sock)
+            transport.write_frame(sock, T_REGISTER,
+                                  transport.encode_register(
+                                      self.partition, self.replica,
+                                      os.getpid(),
+                                      flags=FLAG_WIRE_COMPRESS
+                                      if self.wire_compress else 0,
+                                      generation=self.view.generation))
+            hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                                  name=f"worker-p{self.partition}"
+                                       f"r{self.replica}-hb")
+            hb.start()
             while not self._stop.is_set():
                 frame = transport.read_frame(sock)
                 if frame is None:
@@ -826,7 +837,8 @@ class PartitionWorker:
             pass                  # gateway gone; the process's job is done
         finally:
             self._stop.set()
-            hb.join(timeout=2.0)
+            if hb is not None:
+                hb.join(timeout=2.0)
             try:
                 sock.close()
             except OSError:

@@ -589,6 +589,9 @@ class SearchService:
         self.warm_latency_ms: Optional[float] = None
         self._preload_gb = preload_hbm_gb
         self._refresh_lock = threading.Lock()   # one refresh at a time
+        # the refresh lock is an outer layer: the view build under it
+        # counts fault retries, never the reverse (graftcheck lock-order)
+        # lock-order: SearchService._refresh_lock < faults._COUNTER_LOCK
         self._pset = None
         if self._partitions * self._replicas > 1:
             from dnn_page_vectors_tpu.infer.partition import PartitionSet

@@ -296,6 +296,11 @@ def decode_vquery_any(ftype: int, payload: bytes,
         qv = _block_to_qv(block, n, dim, "interned vquery block")
         slots[slot] = block
         return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
+    if ftype != T_VQUERY_REF:
+        # the explicit REF branch (not a fall-through): a future vquery
+        # variant routed here by mistake must REJECT, not silently parse
+        # as a slot reference
+        raise FrameError(f"frame type {ftype} is not a vquery")
     if len(payload) != off:
         raise FrameError(f"{len(payload) - off} trailing bytes after a "
                          "vquery slot reference")

@@ -88,7 +88,11 @@ class MaintenanceService:
                             if m is not None else 5.0)
         self._lock = threading.Lock()
         # one mutation at a time across pillars AND run_once (re-entrant:
-        # run_once drives all three jobs under one hold)
+        # run_once drives all three jobs under one hold). The mutation
+        # lock is the OUTER layer of the hierarchy — stats/fault counters
+        # nest under it, never the reverse (graftcheck lock-order):
+        # lock-order: MaintenanceService._mlock < MaintenanceService._lock
+        # lock-order: MaintenanceService._mlock < faults._COUNTER_LOCK
         self._mlock = threading.RLock()
         self._stop = threading.Event()
         self._threads: list = []
