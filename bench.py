@@ -454,16 +454,30 @@ def run_worker() -> None:
         "fault_counters": faults.counters(),
         "degraded": bool(faults.counters()),
     }
-    # graftcheck counts ride the bench record (docs/ANALYSIS.md): the
-    # "lint_" keys are lower-is-better, so the regression gate flags
-    # suppression growth exactly like a latency regression — a PR cannot
-    # quietly pragma its way past the analyzer. AST-only, <1 s.
+    # graftcheck counts ride the bench record (docs/ANALYSIS.md): every
+    # "lint_" key is lower-is-better, so the regression gate flags
+    # suppression growth — per family, so a new lock-order/lifecycle/
+    # async/proto pragma flags exactly like a latency regression — and
+    # analyzer wall time (lint_ms) regresses visibly too (the `cli lint
+    # --changed` pre-commit loop depends on it staying fast). AST-only.
     try:
+        from dnn_page_vectors_tpu.tools.analyze import RULES
         from dnn_page_vectors_tpu.tools.analyze import analyze as _lint
+        _t_lint = time.time()
         _lint_report = _lint()
+        rec["lint_ms"] = round((time.time() - _t_lint) * 1000.0, 1)
         rec["lint_findings"] = len(_lint_report.findings)
         rec["lint_suppressions"] = len(_lint_report.suppressed)
         rec["lint_baselined"] = len(_lint_report.baselined)
+        _fam_of = {name: r.family for name, r in RULES.items()}
+        for fam in sorted({r.family for r in RULES.values()}):
+            fkey = fam.replace("-", "_")
+            rec[f"lint_{fkey}_findings"] = sum(
+                1 for f in _lint_report.findings
+                if _fam_of.get(f.rule) == fam)
+            rec[f"lint_{fkey}_suppressions"] = sum(
+                1 for s in _lint_report.suppressed
+                if _fam_of.get(s["rule"]) == fam)
     except Exception as e:   # the analyzer must never cost a bench round
         rec["lint_error"] = f"{type(e).__name__}: {e}"[:300]
     # The REQUIRED metrics are safe from this point: print them before the

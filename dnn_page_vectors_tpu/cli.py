@@ -136,6 +136,13 @@ def main(argv=None) -> None:
     ap.add_argument("--write-baseline", action="store_true",
                     help="lint: accept every current finding into the "
                          "baseline file and exit 0")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint: fast mode — restrict file-scoped rules "
+                         "to files changed vs REF (default HEAD: the "
+                         "working tree) plus untracked files; "
+                         "project-level drift/protocol rules still run "
+                         "whole-repo (docs/ANALYSIS.md)")
     ap.add_argument("--tombstone", default=None, metavar="IDS",
                     help="append: comma-separated page ids to DELETE (their "
                          "vectors mask out of every retrieval path)")
@@ -277,7 +284,29 @@ def main(argv=None) -> None:
         root = args.root or graftcheck.REPO_ROOT
         baseline = args.baseline or os.path.join(root,
                                                  graftcheck.BASELINE_NAME)
-        report = graftcheck.analyze(root=root, baseline_path=baseline)
+        paths = None
+        if args.changed is not None:
+            # the pre-commit fast path: file rules only touch what the
+            # diff touches; project rules still see the whole repo
+            import subprocess as _sp
+            try:
+                diff = _sp.run(
+                    ["git", "diff", "--name-only", args.changed, "--"],
+                    capture_output=True, text=True, cwd=root, check=True)
+                untracked = _sp.run(
+                    ["git", "ls-files", "--others", "--exclude-standard"],
+                    capture_output=True, text=True, cwd=root, check=True)
+            except (OSError, _sp.CalledProcessError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                print(f"lint --changed: git diff against "
+                      f"{args.changed!r} failed: {detail.strip()}",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            paths = sorted(
+                p for p in (diff.stdout + untracked.stdout).splitlines()
+                if p.endswith(".py"))
+        report = graftcheck.analyze(root=root, baseline_path=baseline,
+                                    paths=paths)
         if args.write_baseline:
             graftcheck.write_baseline(
                 baseline, report.findings + report.baselined)
@@ -285,6 +314,10 @@ def main(argv=None) -> None:
                               "entries": len(report.findings)
                               + len(report.baselined)}))
             return
+        if paths is not None:
+            print(f"lint --changed {args.changed}: file rules over "
+                  f"{report.files_scanned} changed file(s); project "
+                  "rules whole-repo", file=sys.stderr)
         for f in report.findings:
             print(f.human(), file=sys.stderr)
         for key in report.stale_baseline:

@@ -1,7 +1,10 @@
-"""graftcheck static-analysis tests (docs/ANALYSIS.md): the five rule
-families' true-positive/true-negative fixture matrix, pragma-suppression
-semantics (line vs file scope, missing-reason rejected), baseline
-add/expire behavior, the `cli lint` JSON report + exit codes, and the
+"""graftcheck static-analysis tests (docs/ANALYSIS.md): the nine rule
+families' true-positive/true-negative fixture matrix (determinism, lock
+discipline, lock-order/deadlock, thread & resource lifecycle, asyncio
+hygiene, jit purity + host-sync, manifest I/O, wire-protocol
+conformance, doc drift), pragma-suppression semantics (line vs file
+scope, missing-reason rejected), baseline add/expire behavior, the
+`cli lint` JSON report + exit codes + `--changed` fast mode, and the
 repo-is-clean tier-1 gate.
 
 Everything here is AST-only: no jax, no devices, no stores — the cli
@@ -142,6 +145,388 @@ def test_locks_rule_matrix():
 def test_locks_scope_is_the_three_threaded_files():
     assert not _rules(analyze_source(
         _LOCK_SRC, "dnn_page_vectors_tpu/infer/bulk_embed.py"), "locks")
+
+
+# ---------------------------------------------------------------------------
+# family: lock-order / deadlock analysis (project rule on a mini tree)
+# ---------------------------------------------------------------------------
+
+_CYCLE_SRC = """
+import threading
+
+
+class Svc:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            self._grab_b()
+
+    def _grab_b(self):
+        with self._b:
+            pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+
+def _lock_project(tmp_path, src):
+    pkg = os.path.join(str(tmp_path), "dnn_page_vectors_tpu", "infer")
+    os.makedirs(pkg, exist_ok=True)
+    with open(os.path.join(pkg, "conc.py"), "w") as f:
+        f.write(src)
+    return str(tmp_path)
+
+
+def test_lock_order_cycle_reports_both_acquisition_paths(tmp_path):
+    r = analyze(root=_lock_project(tmp_path, _CYCLE_SRC))
+    fs = _rules(r.findings, "lock-order")
+    assert len(fs) == 1, [f.human() for f in r.findings]
+    msg = fs[0].msg
+    assert "potential deadlock" in msg
+    assert "`Svc._a` -> `Svc._b`" in msg or "`Svc._b` -> `Svc._a`" in msg
+    # BOTH acquisition paths ride the finding: the call-closure edge
+    # through _grab_b and the direct nested-with edge in two()
+    assert msg.count("held") >= 2, msg
+    assert "_grab_b" in msg
+    assert msg.count("conc.py:") >= 2, msg
+
+
+def test_lock_order_no_cycle_is_clean(tmp_path):
+    src = _CYCLE_SRC.replace(
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n", "")
+    r = analyze(root=_lock_project(tmp_path, src))
+    assert not _rules(r.findings, "lock-order"), [
+        f.human() for f in r.findings]
+
+
+def test_lock_order_declaration_violation_and_unknown_name(tmp_path):
+    src = """
+import threading
+
+
+class Svc:
+    def __init__(self):
+        # lock-order: Svc._b < Svc._a
+        # lock-order: Svc._ghost < Svc._a
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+    r = analyze(root=_lock_project(tmp_path, src))
+    msgs = "\n".join(f.msg for f in _rules(r.findings, "lock-order"))
+    assert "violates the declared hierarchy" in msgs       # a->b vs b<a
+    assert "Svc._ghost" in msgs and "no such lock" in msgs  # stale decl
+
+
+def test_lock_order_declared_hierarchy_is_clean(tmp_path):
+    src = """
+import threading
+
+
+class Svc:
+    def __init__(self):
+        # lock-order: Svc._a < Svc._b
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+    r = analyze(root=_lock_project(tmp_path, src))
+    assert not _rules(r.findings, "lock-order"), [
+        f.human() for f in r.findings]
+
+
+def test_lock_order_rlock_reentry_is_not_a_self_deadlock(tmp_path):
+    src = """
+import threading
+
+
+class Svc:
+    def __init__(self):
+        self._m = threading.RLock()
+
+    def outer(self):
+        with self._m:
+            self.inner()
+
+    def inner(self):
+        with self._m:
+            pass
+"""
+    r = analyze(root=_lock_project(tmp_path, src))
+    assert not _rules(r.findings, "lock-order")
+    plain = src.replace("RLock", "Lock")
+    r2 = analyze(root=_lock_project(tmp_path, plain))
+    msgs = "\n".join(f.msg for f in _rules(r2.findings, "lock-order"))
+    assert "self-deadlock" in msgs
+
+
+# ---------------------------------------------------------------------------
+# family: thread & resource lifecycle
+# ---------------------------------------------------------------------------
+
+_LIFE_POS = """
+import socket
+import threading
+
+
+def leaked_thread():
+    t = threading.Thread(target=print)
+    t.start()                             # never joined, not daemon
+
+
+def happy_path_close(addr):
+    s = socket.create_connection(addr)
+    s.sendall(b"x")
+    s.close()                             # skipped when sendall raises
+
+
+def never_closed(addr):
+    s = socket.create_connection(addr)
+    s.sendall(b"x")
+
+
+def gap_before_try(addr):
+    s = socket.create_connection(addr)
+    s.setsockopt(1, 2, 3)                 # raises -> finally never runs
+    try:
+        s.sendall(b"x")
+    finally:
+        s.close()
+"""
+
+_LIFE_NEG = """
+import socket
+import threading
+
+
+def daemonized():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+
+
+def joined():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()
+
+
+def managed(addr):
+    with socket.create_connection(addr) as s:
+        s.sendall(b"x")
+
+
+def closed_in_finally(addr):
+    s = socket.create_connection(addr)
+    try:
+        s.sendall(b"x")
+    finally:
+        s.close()
+
+
+def transferred(addr):
+    s = socket.create_connection(addr)
+    return s                              # the caller owns it now
+
+
+class Owner:
+    def __init__(self, addr):
+        self._sock = socket.create_connection(addr)
+
+    def close(self):
+        self._sock.close()
+"""
+
+
+def test_lifecycle_true_positives():
+    fs = _rules(analyze_source(
+        _LIFE_POS, "dnn_page_vectors_tpu/infer/fixture.py"), "lifecycle")
+    msgs = "\n".join(f.msg for f in fs)
+    assert len(fs) == 4, [f.human() for f in fs]
+    assert "neither daemonized nor joined" in msgs
+    assert "happy path" in msgs
+    assert "never closed" in msgs
+    assert "between" in msgs and "try/finally" in msgs
+
+
+def test_lifecycle_true_negatives():
+    assert not _rules(analyze_source(
+        _LIFE_NEG, "dnn_page_vectors_tpu/infer/fixture.py"), "lifecycle")
+
+
+def test_lifecycle_unowned_self_attr_is_a_finding():
+    src = ("import socket\n"
+           "class Leaky:\n"
+           "    def __init__(self, addr):\n"
+           "        self._sock = socket.create_connection(addr)\n")
+    fs = _rules(analyze_source(
+        src, "dnn_page_vectors_tpu/infer/fixture.py"), "lifecycle")
+    assert len(fs) == 1 and "leaked on shutdown" in fs[0].msg
+
+
+def test_lifecycle_scope_excludes_models():
+    assert not _rules(analyze_source(
+        _LIFE_POS, "dnn_page_vectors_tpu/models/fixture.py"), "lifecycle")
+
+
+# ---------------------------------------------------------------------------
+# family: asyncio hygiene
+# ---------------------------------------------------------------------------
+
+_ASYNC_POS = """
+import asyncio
+import time
+
+
+async def bad():
+    time.sleep(0.1)                        # blocks the loop
+    open("/tmp/x")                         # file I/O on the loop
+    asyncio.create_task(asyncio.sleep(0))  # discarded task
+    try:
+        await asyncio.sleep(0)
+    except:                                # swallows CancelledError
+        pass
+"""
+
+_ASYNC_NEG = """
+import asyncio
+import time
+
+
+async def good():
+    await asyncio.sleep(0.1)
+    t = asyncio.create_task(asyncio.sleep(0))
+    await t
+    try:
+        await asyncio.sleep(0)
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        pass
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, lambda: time.sleep(0.1))
+
+
+def sync_helper():
+    time.sleep(0.1)                        # executor payload: fine
+"""
+
+
+def test_async_hygiene_true_positives():
+    fs = _rules(analyze_source(
+        _ASYNC_POS, "dnn_page_vectors_tpu/infer/fixture.py"),
+        "async-hygiene")
+    msgs = "\n".join(f.msg for f in fs)
+    assert len(fs) == 4, [f.human() for f in fs]
+    assert "time.sleep" in msgs
+    assert "file I/O" in msgs
+    assert "create_task" in msgs and "discarded" in msgs
+    assert "CancelledError" in msgs
+
+
+def test_async_hygiene_true_negatives():
+    assert not _rules(analyze_source(
+        _ASYNC_NEG, "dnn_page_vectors_tpu/infer/fixture.py"),
+        "async-hygiene")
+
+
+# ---------------------------------------------------------------------------
+# family: wire-protocol conformance (project rule on a mini tree)
+# ---------------------------------------------------------------------------
+
+_MINI_TRANSPORT = '''
+import struct
+
+T_PING = 1
+T_PONG = 2
+
+_TYPES = {T_PING, T_PONG}
+
+_HEAD = struct.Struct("!Q")
+
+
+def decode_ping(payload):
+    if len(payload) != _HEAD.size:
+        raise ValueError("bad ping")
+    return _HEAD.unpack(payload)[0]
+'''
+
+_MINI_SERVING_CLEAN = """# Serving
+
+| type | payload | notes |
+|---|---|---|
+| `PING` | req u64 | ping |
+| `PONG` | empty | pong |
+"""
+
+_MINI_SERVING_DIRTY = """# Serving
+
+| type | payload | notes |
+|---|---|---|
+| `PING` | req u64 | ping |
+| `GONE` | empty | removed long ago |
+"""
+
+
+def _proto_project(tmp_path, doc):
+    root = str(tmp_path)
+    pkg = os.path.join(root, "dnn_page_vectors_tpu", "infer")
+    os.makedirs(pkg, exist_ok=True)
+    os.makedirs(os.path.join(root, "docs"), exist_ok=True)
+    with open(os.path.join(pkg, "transport.py"), "w") as f:
+        f.write(_MINI_TRANSPORT)
+    with open(os.path.join(root, "docs", "SERVING.md"), "w") as f:
+        f.write(doc)
+    return root
+
+
+def test_proto_drift_catches_missing_and_stale_rows(tmp_path):
+    r = analyze(root=_proto_project(tmp_path, _MINI_SERVING_DIRTY))
+    msgs = "\n".join(f.msg for f in _rules(r.findings, "proto-drift"))
+    assert "T_PONG" in msgs and "no row" in msgs        # constant undocumented
+    assert "GONE" in msgs and "stale" in msgs           # row without constant
+    # PONG's payload is unknown (no row), so the missing decode branch
+    # flags too
+    assert "no bounded-length decode branch" in msgs
+
+
+def test_proto_drift_clean_table_passes(tmp_path):
+    r = analyze(root=_proto_project(tmp_path, _MINI_SERVING_CLEAN))
+    assert not _rules(r.findings, "proto-drift"), [
+        f.human() for f in r.findings]
+
+
+def test_proto_drift_unregistered_type_and_unguarded_decoder(tmp_path):
+    src = _MINI_TRANSPORT.replace(
+        "_TYPES = {T_PING, T_PONG}", "_TYPES = {T_PING}").replace(
+        '    if len(payload) != _HEAD.size:\n'
+        '        raise ValueError("bad ping")\n', "").replace(
+        "    return _HEAD.unpack(payload)[0]",
+        "    return _HEAD.unpack_from(payload)[0]")
+    root = _proto_project(tmp_path, _MINI_SERVING_CLEAN)
+    with open(os.path.join(root, "dnn_page_vectors_tpu", "infer",
+                           "transport.py"), "w") as f:
+        f.write(src)
+    msgs = "\n".join(f.msg for f in _rules(
+        analyze(root=root).findings, "proto-drift"))
+    assert "not registered in `_TYPES`" in msgs
+    assert "no length guard" in msgs
 
 
 # ---------------------------------------------------------------------------
@@ -480,6 +865,74 @@ def test_cli_lint_exits_zero_on_clean_tree_and_after_write_baseline(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# cli lint --changed: the fast pre-commit mode (docs/ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+def test_analyze_paths_restricts_file_rules_only(tmp_path):
+    root = str(tmp_path)
+    pkg = os.path.join(root, "dnn_page_vectors_tpu", "infer")
+    os.makedirs(pkg, exist_ok=True)
+    bad = ("import numpy as np\n"
+           "x = np.random.rand(3)\n")
+    for name in ("one.py", "two.py"):
+        with open(os.path.join(pkg, name), "w") as f:
+            f.write(bad)
+    full = analyze(root=root)
+    assert len(_rules(full.findings, "determinism")) == 2
+    part = analyze(root=root,
+                   paths=["dnn_page_vectors_tpu/infer/one.py"])
+    fs = _rules(part.findings, "determinism")
+    assert len(fs) == 1 and fs[0].path.endswith("one.py")
+    assert part.files_scanned == 1
+
+
+def test_analyze_paths_suppresses_stale_baseline(tmp_path):
+    root = _mini_project(str(tmp_path))
+    baseline = os.path.join(root, BASELINE_NAME)
+    write_baseline(baseline, analyze(root=root).findings)
+    _mini_project(str(tmp_path), clean=True)     # everything fixed
+    full = analyze(root=root)
+    assert full.stale_baseline                   # full mode reports stale
+    part = analyze(root=root, paths=[])
+    assert not part.stale_baseline               # restricted mode cannot
+
+
+def test_cli_lint_changed_runs_project_rules_on_the_real_repo():
+    """`--changed HEAD` on this checkout: file rules over only the
+    diffed files, project rules whole-repo, exit 0 (the repo is clean).
+    Also pins the stderr mode banner and that the JSON shape is the
+    plain report."""
+    if not os.path.isdir(os.path.join(_REPO, ".git")):
+        pytest.skip("not a git checkout")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dnn_page_vectors_tpu.cli", "lint",
+         "--root", _REPO, "--changed", "HEAD"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["counts"]["findings"] == 0
+    # the project-level rules ran regardless of the diff restriction
+    assert "proto-drift" in report["rules"]
+    assert "lock-order" in report["rules"]
+    assert "--changed" in proc.stderr or "changed" in proc.stderr
+
+
+def test_cli_lint_changed_bad_ref_exits_2(tmp_path):
+    if not os.path.isdir(os.path.join(_REPO, ".git")):
+        pytest.skip("not a git checkout")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dnn_page_vectors_tpu.cli", "lint",
+         "--root", _REPO, "--changed", "no-such-ref-xyzzy"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 2
+    assert "failed" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
 # the repo itself is clean — the tier-1 gate behind `cli lint` exit 0
 # ---------------------------------------------------------------------------
 
@@ -547,4 +1000,5 @@ def test_rule_registry_documented():
     for name in RULES:
         assert f"`{name}`" in doc, f"rule `{name}` missing from ANALYSIS.md"
     families = {r.family for r in RULES.values()}
-    assert {"determinism", "locks", "jit", "io", "drift"} <= families
+    assert {"determinism", "locks", "jit", "io", "drift",
+            "lock-order", "lifecycle", "async", "proto"} <= families
