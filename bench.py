@@ -118,9 +118,17 @@ _GATE_SKIP = {"vs_baseline", "attempts", "slo_p99_target_ms",
               # net_hedge_fire_rate, net_deadline_shed_rate) all gate
               "net_store_rows", "net_shards", "net_dim", "net_k",
               "net_p99_target_ms", "net_workers", "net_cores",
-              "net_wire_bytes_per_query_raw"}
+              "net_wire_bytes_per_query_raw",
+              # cache_serve protocol constants (store geometry, the
+              # workload's distinct-query count) and state gauges
+              # (entry count tracks the workload, not performance) —
+              # the phase's MEASURED keys (cache_serve_qps_at_p99_on/
+              # _off, cache_serve_speedup, cache_hit_rate higher-is-
+              # better; cache_serve_us_per_hit lower-is-better) all gate
+              "cache_store_rows", "cache_dim", "cache_k",
+              "cache_distinct", "cache_entries"}
 _LOWER_IS_BETTER = ("_ms", "seconds", "imbalance", "error", "_bytes",
-                    "lint_", "shed", "hedge")
+                    "lint_", "shed", "hedge", "_us_per_")
 
 
 def _lower_is_better(key: str) -> bool:
@@ -1958,6 +1966,165 @@ def run_net_worker() -> None:
     print(json.dumps(rec), flush=True)
 
 
+def run_cache_worker() -> None:
+    """cache_serve phase: CPU-honest A/B of the generation-keyed result
+    cache on the Zipfian head. The SAME synthetic store and the SAME
+    Zipf-mix workload are priced twice through the real serving path —
+    once with `serve.result_cache` on (a hit short-circuits BEFORE the
+    request consumes a micro-batch slot) and once off — reported as
+    qps@p99 per arm plus the measured hit rate and the per-hit serve
+    cost. The embed hop is stubbed to a deterministic name->vector map:
+    the result cache keys on query TEXT, and what this phase prices is
+    everything after the key (probe, skipped top-k, format) — the off
+    arm still pays the full scan, so the ratio isolates the cache."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import shutil
+
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.infer.partition_host import MeshEmbedder
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    from dnn_page_vectors_tpu.loadgen import find_qps_at_p99, make_workload
+
+    dim = int(os.environ.get("BENCH_CACHE_DIM", "64"))
+    shard_rows = int(os.environ.get("BENCH_CACHE_SHARD_ROWS", "16384"))
+    n_shards = int(os.environ.get("BENCH_CACHE_SHARDS", "4"))
+    trial_s = float(os.environ.get("BENCH_CACHE_TRIAL_S", "1.5"))
+    p99_ms = float(os.environ.get("BENCH_CACHE_P99_MS", "200"))
+    iters = int(os.environ.get("BENCH_CACHE_ITERS", "2"))
+    start_qps = float(os.environ.get("BENCH_CACHE_START_QPS", "16"))
+    reps = max(1, int(os.environ.get("BENCH_CACHE_REPS", "2")))
+    # 32 distinct queries under the workload's Zipfian repeat profile:
+    # small enough that the head fits the default cache, large enough
+    # that the off arm can't live off the embed LRU alone
+    distinct = int(os.environ.get("BENCH_CACHE_DISTINCT", "32"))
+    kq = 10
+    rows = shard_rows * n_shards
+    wdir = "/tmp/dnn_page_vectors_tpu_bench/cache"
+    sdir = os.path.join(wdir, "store")
+    _stamp(f"cache phase: building {rows}-row synthetic store "
+           f"({n_shards} shards, dim {dim})")
+    rng = np.random.default_rng(0)
+    shutil.rmtree(wdir, ignore_errors=True)
+    store = VectorStore(sdir, dim=dim, shard_size=shard_rows)
+    for si in range(n_shards):
+        v = rng.standard_normal((shard_rows, dim)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(si * shard_rows,
+                                        (si + 1) * shard_rows,
+                                        dtype=np.int64), v)
+    store = VectorStore(sdir)
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+    qvs = rng.standard_normal((distinct, dim)).astype(np.float32)
+    qvs /= np.linalg.norm(qvs, axis=1, keepdims=True)
+    qnames = [f"q{i}" for i in range(distinct)]
+    qvec = {name: qvs[i:i + 1] for i, name in enumerate(qnames)}
+
+    def _stub_embed(queries):
+        return np.concatenate([qvec[q] for q in queries], axis=0)
+
+    class _StubCorpus:
+        def page_text(self, i):
+            return f"page {i}"
+
+    rec = {"cache_store_rows": rows, "cache_dim": dim, "cache_k": kq,
+           "cache_distinct": distinct}
+    wl = make_workload("poisson", seed=0, distinct=distinct,
+                       profile=((kq, None, 1.0),))
+    qps = {}
+    for label, on in (("on", True), ("off", False)):
+        cfg = get_config("cdssm_toy", {
+            "model.out_dim": dim,
+            # window == trial duration: each trial's p99 reads its OWN
+            # window (the slo-phase discipline)
+            "obs.window_s": trial_s,
+            "serve.result_cache": on})
+        svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                            preload_hbm_gb=4.0)
+        svc._embed_queries_cached = _stub_embed
+        svc.corpus = _StubCorpus()
+        try:
+            svc.search(qnames[0], k=kq)        # warm every compiled shape
+            _stamp(f"cache arm={label}: searching qps @ "
+                   f"p99<{p99_ms:.0f}ms (best of {reps})")
+            best, n_trials = 0.0, 0
+            for _ in range(reps):
+                rep = find_qps_at_p99(
+                    svc, wl, qnames, p99_target_ms=p99_ms,
+                    start=start_qps, iters=iters, duration_s=trial_s,
+                    warmup_s=0.5, workers=16)
+                best = max(best, rep["qps_at_p99"])
+                n_trials += len(rep["trials"])
+            qps[label] = best
+            rec[f"cache_serve_qps_at_p99_{label}"] = round(best, 2)
+            _stamp(f"cache arm={label}: {best:.1f} qps @ "
+                   f"p99<{p99_ms:.0f}ms ({n_trials} trials)")
+            if on:
+                met = svc.metrics().get("result_cache") or {}
+                hits = int(met.get("hits") or 0)
+                misses = int(met.get("misses") or 0)
+                if hits + misses:
+                    rec["cache_hit_rate"] = round(
+                        hits / (hits + misses), 4)
+                rec["cache_entries"] = int(met.get("entries") or 0)
+                # per-hit serve cost: one resident key hammered on a
+                # quiet service — the probe+copy path alone, no scan
+                svc.search(qnames[0], k=kq)
+                n_hot = 2000
+                t0 = time.perf_counter()
+                for _ in range(n_hot):
+                    svc.search(qnames[0], k=kq)
+                rec["cache_serve_us_per_hit"] = round(
+                    (time.perf_counter() - t0) / n_hot * 1e6, 2)
+        finally:
+            svc.close()
+    if qps.get("on") and qps.get("off"):
+        rec["cache_serve_speedup"] = round(qps["on"] / qps["off"], 3)
+        _stamp(f"cache A/B: x{rec['cache_serve_speedup']:.2f} qps@p99 "
+               f"with the result cache on (hit rate "
+               f"{rec.get('cache_hit_rate', 0):.2f})")
+    print(json.dumps(rec), flush=True)
+
+
+def _run_cache() -> dict:
+    """Run the result-cache A/B phase in a CPU subprocess and return its
+    keys — merged into every record like the partitioned and net phases,
+    so the Zipf-head cache numbers re-seed the baseline with no TPU."""
+    if os.environ.get("BENCH_CACHE", "1") == "0":
+        return {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cache-worker"],
+            capture_output=True, text=True,
+            timeout=int(os.environ.get("BENCH_CACHE_TIMEOUT_S", "600")),
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "cache_store_rows" in rec:
+                return rec
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"cache_error":
+                (" | ".join(tail[-3:]) if tail
+                 else f"rc={proc.returncode}")[:300]}
+    except subprocess.TimeoutExpired:
+        return {"cache_error": "cache worker timed out"}
+    except Exception as e:  # noqa: BLE001 — the phase never costs a round
+        return {"cache_error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def _run_net() -> dict:
     """Run the net_serve phase in a CPU subprocess and return its keys —
     merged into every record (null-honest device phases included), so
@@ -2108,6 +2275,7 @@ def main() -> None:
     }
     rec.update(_run_partitioned())
     rec.update(_run_net())
+    rec.update(_run_cache())
     print(json.dumps(rec))
 
 
@@ -2117,6 +2285,7 @@ def _finalize(rec: dict) -> None:
     record (the one the driver parses)."""
     rec.update(_run_partitioned())
     rec.update(_run_net())
+    rec.update(_run_cache())
     prev = _previous_bench_record()
     _, regs = _regression_gate(rec, prev)
     rec["regressions"] = regs
@@ -2131,5 +2300,7 @@ if __name__ == "__main__":
         run_partitioned_worker()
     elif "--net-worker" in sys.argv:
         run_net_worker()
+    elif "--cache-worker" in sys.argv:
+        run_cache_worker()
     else:
         main()

@@ -229,6 +229,15 @@ def main(argv=None) -> None:
                     help="loadtest/search: serve.replicas override — R "
                          "health-routed copies of every partition "
                          "(shorthand for --set serve.replicas=R)")
+    ap.add_argument("--result-cache", dest="result_cache", default=None,
+                    choices=["on", "off"],
+                    help="loadtest: generation-keyed result cache A/B "
+                         "switch — 'on' enables serve.result_cache (and, "
+                         "with --transport socket, the fleet-shared "
+                         "CACHE_LOOKUP/CACHE_PUT hop) so the report gains "
+                         "a result_cache block (hits, misses, hit_rate, "
+                         "bytes; docs/SERVING.md 'Result cache'); 'off' "
+                         "forces it off regardless of --set overrides")
     ap.add_argument("--transport", default="inproc",
                     choices=["inproc", "socket"],
                     help="loadtest: 'socket' runs the asyncio front end "
@@ -352,6 +361,15 @@ def main(argv=None) -> None:
         if args.replicas is not None:
             over["replicas"] = max(1, args.replicas)
         cfg = cfg.replace(serve=_dc.replace(cfg.serve, **over))
+    if getattr(args, "result_cache", None) is not None:
+        # --result-cache on/off: the A/B switch over serve.result_cache;
+        # 'on' over a socket transport also enables the fleet-shared hop
+        # (FLAG_RESULT_CACHE, docs/SERVING.md "Result cache")
+        import dataclasses as _dc
+        rc_on = args.result_cache == "on"
+        cfg = cfg.replace(serve=_dc.replace(
+            cfg.serve, result_cache=rc_on,
+            result_cache_fleet=bool(rc_on and args.transport == "socket")))
 
     # fault injection (only when a plan is configured) + the always-on
     # transient-I/O retry policy — every command goes through this
@@ -834,6 +852,15 @@ def main(argv=None) -> None:
                             "--partitions", str(P)]
                 for pair in args.overrides or []:
                     base_cmd += ["--set", pair]
+                if args.result_cache is not None:
+                    # the --result-cache A/B must reach the worker
+                    # subprocesses too — they advertise
+                    # FLAG_RESULT_CACHE at REGISTER off their own config
+                    base_cmd += [
+                        "--set",
+                        f"serve.result_cache={cfg.serve.result_cache}",
+                        "--set", "serve.result_cache_fleet="
+                                 f"{cfg.serve.result_cache_fleet}"]
                 for wp in range(P):
                     for wr in range(R):
                         worker_procs.append(subprocess.Popen(
@@ -849,9 +876,12 @@ def main(argv=None) -> None:
                         "workers_live": len(gateway.live_workers()),
                         "expected": P * R}), file=sys.stderr, flush=True)
             net_server = serve_in_background(svc)
-            client = SocketSearchClient(net_server.host, net_server.port,
-                                        deadline_ms=cfg.serve.deadline_ms,
-                                        compress=cfg.serve.wire_compress)
+            client = SocketSearchClient(
+                net_server.host, net_server.port,
+                deadline_ms=cfg.serve.deadline_ms,
+                compress=cfg.serve.wire_compress,
+                result_cache=bool(cfg.serve.result_cache
+                                  and cfg.serve.result_cache_fleet))
         distinct = max(1, args.distinct)
         queries = [trainer.corpus.query_text(i) for i in range(distinct)]
         wl = make_workload(args.shape, seed=args.seed, distinct=distinct,
@@ -902,6 +932,13 @@ def main(argv=None) -> None:
                 **({"transport_totals": final_met["transport"]}
                    if "transport" in final_met else {}),
             })
+        if cfg.serve.result_cache:
+            # result-cache block (docs/SERVING.md "Result cache"): run
+            # totals straight off the registry — per-trial deltas ride
+            # each trial record (loadgen/driver.py)
+            rc_met = svc.metrics()
+            if "result_cache" in rc_met:
+                report["result_cache"] = rc_met["result_cache"]
         if maint is not None:
             final_met = svc.metrics()
             report.update({

@@ -301,6 +301,23 @@ class ServeConfig:
     # traffic. False = every connection negotiates down to raw frames
     # (the PR-13 wire format); mixed fleets interoperate either way.
     wire_compress: bool = True
+    # Generation-keyed result cache (docs/SERVING.md "Result cache"):
+    # formatted top-k results keyed by (normalized text, k, nprobe, store
+    # generation, index generation), probed at the admission door before a
+    # repeat can consume a micro-batch bucket slot. refresh() bumps the
+    # generations, so invalidation is free — a post-append repeat can
+    # never serve pre-append results. Off by default: repeats then take
+    # the full path (embedding cache still applies).
+    result_cache: bool = False
+    # Result-cache capacity (entries, LRU). 0 disables even when
+    # serve.result_cache is true.
+    result_cache_size: int = 4096
+    # Fleet-wide sharing of the result cache over the wire: advertise
+    # FLAG_RESULT_CACHE in REGISTER/HELLO and answer CACHE_LOOKUP /
+    # CACHE_PUT frames, so N front ends (and the worker RPC hop) share
+    # one hot set. Requires serve.result_cache; mixed fleets where one
+    # side never negotiated the flag degrade to local-only caching.
+    result_cache_fleet: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -170,6 +170,15 @@ class IVFIndex:
         self.stats = {"searches": 0, "lists_scanned": 0,
                       "candidates_reranked": 0, "gather_bytes": 0,
                       "reranked_rows": 0, "hot_rows_scored": 0}
+        # windowed per-list popularity table (docs/ANN.md "Popularity
+        # tiering"): every search adds its probed-list histogram here,
+        # and stage_hot ranks by it — then HALVES it, so the resident
+        # hot set tracks the current Zipf head instead of raw list size.
+        # Approximate like `stats`: racing increments may drop a count,
+        # never corrupt the ranking.
+        # graftcheck: off=locks -- approximate telemetry, single array
+        # rebind on decay; a lost increment only nudges the ranking
+        self.scan_counts = np.zeros((self.nlist,), np.int64)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -732,7 +741,20 @@ class IVFIndex:
         per_row = self.pq.m + 4                 # code bytes + centroid id
         resident = np.zeros((self.nlist,), bool)
         used = 0
-        for c in np.argsort(-self.list_sizes, kind="stable"):
+        # popularity-driven ranking (docs/ANN.md "Popularity tiering"):
+        # with measured probe counts, pin the HOTTEST lists (size breaks
+        # ties, deterministically); a cold table — fresh build, restart —
+        # degrades to the original biggest-first order. The table is
+        # halved after ranking, so each restage sees a decayed window of
+        # recent traffic, not all-time totals.
+        counts = np.asarray(self.scan_counts)
+        by_popularity = bool(counts.sum() > 0)
+        if by_popularity:
+            order = np.lexsort((-self.list_sizes, -counts))
+        else:
+            order = np.argsort(-self.list_sizes, kind="stable")
+        self.scan_counts = counts >> 1
+        for c in order:
             need = int(self.list_sizes[c]) * per_row
             if self.list_sizes[c] == 0 or used + need > budget_bytes:
                 continue                        # smaller lists may still fit
@@ -743,7 +765,8 @@ class IVFIndex:
         n = codes.shape[0]
         if n == 0:
             self._hot = None
-            return {"hot_lists": 0, "hot_rows": 0, "hot_bytes": 0}
+            return {"hot_lists": 0, "hot_rows": 0, "hot_bytes": 0,
+                    "hot_by_popularity": by_popularity}
         pad = _bucket(n, lo=512)
         if pad > n:
             codes = np.concatenate(
@@ -754,7 +777,7 @@ class IVFIndex:
             "codes": jnp.asarray(codes), "cent": jnp.asarray(cent),
             "chunk": min(2048, pad), "ids": ids, "shard": sh, "row": rw}
         return {"hot_lists": int(resident.sum()), "hot_rows": n,
-                "hot_bytes": used}
+                "hot_bytes": used, "hot_by_popularity": by_popularity}
 
     def _gather(self, cents: np.ndarray):
         """Candidate block for one probed-list union: rows of every listed
@@ -822,6 +845,10 @@ class IVFIndex:
         _, sel = chunked_topk(jnp.asarray(qpad), self._dev_centroids,
                               k=nprobe, chunk=8192)
         sel = np.asarray(sel, np.int32)[:nq]
+        # feed the popularity table: one count per (query, probed list).
+        # bincount over the flat selection is one vectorized pass — the
+        # per-search cost of popularity tiering is this line.
+        self.scan_counts += np.bincount(sel.ravel(), minlength=self.nlist)
         stats = {"searches": nq, "lists_scanned": nq * nprobe,
                  "candidates_reranked":
                      int(self.list_sizes[sel].sum()),

@@ -55,6 +55,7 @@ import os
 import socket
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import FIRST_COMPLETED, Future
 from concurrent.futures import wait as futures_wait
 from typing import Dict, List, Optional, Tuple
@@ -63,10 +64,10 @@ import numpy as np
 
 from dnn_page_vectors_tpu.infer import transport
 from dnn_page_vectors_tpu.infer.transport import (
-    DeadlineExceeded, FrameError, FLAG_WIRE_COMPRESS, FrameSender,
-    InternTable, RemoteError, T_BYE, T_HEARTBEAT, T_HELLO, T_REFRESH,
-    T_REGISTER, T_RESULT, T_RESULT_C, T_SHED, T_ERROR, T_VQUERY,
-    T_VQUERY_PUT, T_VQUERY_REF)
+    DeadlineExceeded, FrameError, FLAG_RESULT_CACHE, FLAG_WIRE_COMPRESS,
+    FrameSender, InternTable, RemoteError, T_BYE, T_HEARTBEAT, T_HELLO,
+    T_REFRESH, T_REGISTER, T_RESULT, T_RESULT_C, T_SHED, T_ERROR,
+    T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF)
 from dnn_page_vectors_tpu.ops.topk import merge_partition_topk
 from dnn_page_vectors_tpu.utils.profiling import LatencyStats
 
@@ -166,6 +167,14 @@ class WorkerGateway:
         # talks raw frames regardless of worker capability
         self._compress = bool(getattr(serve_cfg, "wire_compress", True)
                               if serve_cfg is not None else True)
+        # fleet result cache (docs/SERVING.md "Result cache"): what THIS
+        # end confirms when a worker advertises FLAG_RESULT_CACHE — the
+        # worker then answers repeated vector blocks from its per-hop
+        # block cache instead of re-scanning
+        self._rcache = bool(
+            serve_cfg is not None
+            and getattr(serve_cfg, "result_cache", False)
+            and getattr(serve_cfg, "result_cache_fleet", False))
         self.rpc_timeout_s = float(rpc_timeout_s)
         self._own_pset = None
         if pset is None:
@@ -235,7 +244,8 @@ class WorkerGateway:
             self._account(transport.HEADER.size + len(frame[1]))
             pid_, rid, wpid, wflags, wgen = transport.decode_register(
                 frame[1])
-            agreed = wflags & (FLAG_WIRE_COMPRESS if self._compress else 0)
+            agreed = wflags & ((FLAG_WIRE_COMPRESS if self._compress else 0)
+                               | (FLAG_RESULT_CACHE if self._rcache else 0))
             worker = _WorkerConn(conn, addr, pid_, rid, wpid,
                                  flags=agreed, generation=wgen)
             with self._lock:
@@ -256,6 +266,7 @@ class WorkerGateway:
                 "partition": pid_, "replica": rid, "pid": wpid,
                 "addr": f"{addr[0]}:{addr[1]}",
                 "wire_compress": bool(agreed & FLAG_WIRE_COMPRESS),
+                "result_cache": bool(agreed & FLAG_RESULT_CACHE),
                 "generation": wgen})
             while True:
                 frame = transport.read_frame(conn)
@@ -753,7 +764,20 @@ class PartitionWorker:
         # the gateway confirms (T_HELLO ack) — a raw gateway, or a raw
         # sibling on the same gateway, interoperates untouched
         self.wire_compress = bool(getattr(cfg.serve, "wire_compress", True))
+        # fleet result cache, advertised like compression and only used
+        # after the gateway confirms: repeated vector blocks (the Zipf
+        # head re-encoded to the same query matrix) replay their scored
+        # answer without touching the store
+        self.result_cache = bool(
+            getattr(cfg.serve, "result_cache", False)
+            and getattr(cfg.serve, "result_cache_fleet", False))
         self._flags = 0           # agreed capabilities (run-loop only)
+        # per-hop block cache: (query-block bytes, k, nprobe, store gen,
+        # index gen) -> (scores, ids, scan). Run-loop only (like _flags
+        # — _answer is only ever called from run()); sized to the intern
+        # table's order of magnitude, cleared on every view swap.
+        self._block_cache: OrderedDict = OrderedDict()  # run-loop only
+        self._block_cache_cap = 64
         # drill hook (tests, the bench hedge drill): added per-request
         # latency, so a deliberately slow replica provokes hedging
         self.slow_ms = float(slow_ms)
@@ -810,8 +834,10 @@ class PartitionWorker:
                                   transport.encode_register(
                                       self.partition, self.replica,
                                       os.getpid(),
-                                      flags=FLAG_WIRE_COMPRESS
-                                      if self.wire_compress else 0,
+                                      flags=(FLAG_WIRE_COMPRESS
+                                             if self.wire_compress else 0)
+                                      | (FLAG_RESULT_CACHE
+                                         if self.result_cache else 0),
                                       generation=self.view.generation))
             hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
                                   name=f"worker-p{self.partition}"
@@ -873,6 +899,9 @@ class PartitionWorker:
             self.spec = spec
             self.view = view     # THE swap: one reference assignment
             self.svc.store = new_store
+            # the block cache keys carry the old generations — clear
+            # eagerly rather than letting dead entries squat the LRU
+            self._block_cache.clear()
         except Exception:  # noqa: BLE001 — keep serving the old view
             pass
         try:
@@ -892,8 +921,30 @@ class PartitionWorker:
             if self.slow_ms > 0:
                 time.sleep(self.slow_ms / 1000.0)
             k = req.k or self.svc.cfg.eval.recall_k
-            scores, ids, scan = self.svc._topk_view(
-                self.view, req.qv, req.qv.shape[0], k, req.nprobe or None)
+            ckey = None
+            hit = None
+            if self._flags & FLAG_RESULT_CACHE:
+                # per-hop block cache: the generation-qualified key makes
+                # a replayed answer byte-identical to a recompute on THIS
+                # view — and unreachable the moment a refresh swaps it
+                idx = self.view.index
+                ckey = (req.qv.tobytes(), k, int(req.nprobe or 0),
+                        int(self.view.generation),  # graftcheck: off=host-sync -- generations are host ints, never device arrays
+                        int(idx.index_generation) if idx is not None  # graftcheck: off=host-sync -- generations are host ints, never device arrays
+                        else -1)
+                hit = self._block_cache.get(ckey)
+                if hit is not None:
+                    self._block_cache.move_to_end(ckey)
+            if hit is not None:
+                scores, ids, scan = hit
+            else:
+                scores, ids, scan = self.svc._topk_view(
+                    self.view, req.qv, req.qv.shape[0], k,
+                    req.nprobe or None)
+                if ckey is not None:
+                    self._block_cache[ckey] = (scores, ids, scan)
+                    while len(self._block_cache) > self._block_cache_cap:
+                        self._block_cache.popitem(last=False)
             if req.deadline_ms > 0 and \
                     (time.perf_counter() - t0) * 1000.0 > req.deadline_ms:
                 # the budget died during compute: a late answer is waste

@@ -481,6 +481,34 @@ class SearchService:
         self._cache_cap = (serve_cfg.query_cache_size
                            if serve_cfg is not None else 0)
         self._cache_lock = threading.Lock()
+        # Generation-keyed result cache (docs/SERVING.md "Result cache"):
+        # (normalized text, k, nprobe, store generation, index generation)
+        # -> formatted top-k hits, probed at the admission door BEFORE a
+        # repeat can consume a micro-batch bucket slot. refresh() bumps
+        # the generations, so a swap invalidates for free — stale entries
+        # age out of the LRU under unreachable keys.
+        self._rcache_cap = (
+            serve_cfg.result_cache_size
+            if serve_cfg is not None
+            and getattr(serve_cfg, "result_cache", False) else 0)
+        # fleet sharing (FLAG_RESULT_CACHE / T_CACHE_* frames) rides on
+        # top of the local cache — never enabled without it
+        self._rcache_fleet = bool(
+            self._rcache_cap
+            and getattr(serve_cfg, "result_cache_fleet", False))
+        # guarded-by: _rcache_lock
+        self._rcache: "OrderedDict[tuple, list]" = OrderedDict()
+        # guarded-by: _rcache_lock
+        self._rcache_bytes = 0
+        self._rcache_lock = threading.Lock()
+        # result-cache peers (attach_cache_peers): SocketSearchClient
+        # handles to sibling front ends sharing the hot set
+        # guarded-by: _rcache_lock
+        self._rcache_peers: list = []
+        self._m_rcache_hits = reg.counter("serve.result_cache_hits",
+                                          window_s=window_s)
+        self._m_rcache_misses = reg.counter("serve.result_cache_misses",
+                                            window_s=window_s)
         # IVF ANN routing (docs/ANN.md): serve.index="ivf" tries the
         # inverted-file index; every request re-checks it against the
         # store's stamp and falls back to the exact path (counted) when
@@ -656,6 +684,14 @@ class SearchService:
     @property
     def cache_misses(self) -> int:
         return self._m_cache_misses.value
+
+    @property
+    def result_cache_hits(self) -> int:
+        return self._m_rcache_hits.value
+
+    @property
+    def result_cache_misses(self) -> int:
+        return self._m_rcache_misses.value
 
     @property
     def ann_lists_scanned(self) -> int:
@@ -964,6 +1000,25 @@ class SearchService:
             self._log.write({"serve_refresh": self.refreshes, **info})
         return info
 
+    def restage_hot(self) -> Dict:
+        """Re-rank and re-stage the CURRENT view's HBM-resident hot
+        posting set against the measured popularity window (docs/ANN.md
+        "Popularity tiering") — no store re-open, no view swap: the same
+        index object re-pins the lists its own scan counts say are
+        hottest, then halves the window. The staged state publishes with
+        one reference assignment, so in-flight ADC searches finish on
+        whichever residency they captured. Returns the stage_hot summary
+        ({} when there is nothing to restage: exact serving, no PQ, or
+        no HBM budget), and emits a `hot_restaged` event."""
+        view = self._view
+        idx = view.index if view is not None else None
+        if idx is None or idx.pq is None or self._hot_gb <= 0:
+            return {}
+        with self._refresh_lock:
+            hot = idx.stage_hot(self._hot_gb * 2 ** 30)
+        self.registry.event("hot_restaged", dict(hot))
+        return hot
+
     def _build_view(self, store: VectorStore, reuse: "_ServeView" = None,
                     update_index: bool = False,
                     entries: Optional[List[Dict]] = None,
@@ -1007,7 +1062,7 @@ class SearchService:
                 view, update_index,
                 shard_indices=([e["index"] for e in view.entries]
                                if view.restricted else None),
-                hot_gb=hot_gb)
+                hot_gb=hot_gb, reuse=reuse)
             if (reuse is not None and reuse.index_error is not None
                     and view.index is not None):
                 # a degraded-to-exact view healed across the refresh
@@ -1019,7 +1074,8 @@ class SearchService:
     # -- IVF ANN index (docs/ANN.md, docs/UPDATES.md) ----------------------
     def _attach_index(self, view: "_ServeView", update_index: bool,
                       shard_indices: Optional[List[int]] = None,
-                      hot_gb: Optional[float] = None) -> None:
+                      hot_gb: Optional[float] = None,
+                      reuse: "_ServeView" = None) -> None:
         from dnn_page_vectors_tpu.index.ivf import IndexUnavailable, IVFIndex
         hot_gb = self._hot_gb if hot_gb is None else hot_gb
         try:
@@ -1053,6 +1109,15 @@ class SearchService:
                 # and the hot staging below all see the partition's
                 # shards and nothing else (index/ivf.py partition_view)
                 view.index = view.index.partition_view(shard_indices)
+            if (view.index is not None and reuse is not None
+                    and reuse.index is not None
+                    and reuse.index.nlist == view.index.nlist):
+                # carry the measured popularity window across the view
+                # rebuild (docs/ANN.md "Popularity tiering"): the fresh
+                # index object starts cold, but the traffic didn't — the
+                # staged hot set below should keep tracking the head
+                # instead of reverting to biggest-first on every refresh
+                view.index.scan_counts = reuse.index.scan_counts.copy()
             if (view.index is not None and view.index.pq is not None
                     and hot_gb > 0):
                 # HBM-resident hot posting set (docs/ANN.md): staged per
@@ -1291,8 +1356,172 @@ class SearchService:
         return " ".join(query.split())
 
     def clear_cache(self) -> None:
+        """Flush EVERY serving cache — the query-embedding LRU and the
+        generation-keyed result cache — and emit a `cache_cleared` event.
+        The manual escape hatch for out-of-band store mutation: normal
+        refresh() never needs it (generation keys invalidate for free),
+        but a store mutated underneath a live view would otherwise keep
+        stale results servable."""
         with self._cache_lock:
+            embed_n = len(self._cache)
             self._cache.clear()
+        with self._rcache_lock:
+            result_n = len(self._rcache)
+            self._rcache.clear()
+            self._rcache_bytes = 0
+        self.registry.event("cache_cleared", {
+            "embed_entries": embed_n, "result_entries": result_n})
+
+    # -- generation-keyed result cache (docs/SERVING.md "Result cache") ---
+    def _result_cache_key(self, query: str, k: Optional[int],
+                          nprobe: Optional[int],
+                          view=None) -> Optional[tuple]:
+        """(normalized text, k, nprobe, store gen, index gen) — or None
+        when the cache is off. Generations in the KEY are the whole
+        invalidation story: refresh() bumps them, so an entry filled
+        against the old view can never answer a post-swap probe."""
+        if self._rcache_cap <= 0:
+            return None
+        if view is None:
+            view = self._view
+        if view is None:
+            return None          # partitioned serving caches per-request
+        index_gen = (view.index.index_generation
+                     if view.index is not None else -1)
+        return (self._normalize(query), int(k or self.cfg.eval.recall_k),
+                int(nprobe or 0), int(view.generation), int(index_gen))
+
+    def _result_cache_get(self, key: Optional[tuple],
+                          count: bool = True) -> Optional[list]:
+        if key is None:
+            return None
+        with self._rcache_lock:
+            hits = self._rcache.get(key)
+            if hits is not None:
+                self._rcache.move_to_end(key)
+        if hits is None:
+            if count:
+                self._m_rcache_misses.inc()
+            return None
+        if count:
+            self._m_rcache_hits.inc()
+        # copy per hit: callers may mutate the dicts they receive, and
+        # the cached entry must stay byte-identical for the next repeat
+        return [dict(h) for h in hits]
+
+    def _result_cache_put(self, key: Optional[tuple], hits: list) -> None:
+        if key is None:
+            return
+        size = 96 + sum(64 + len(h.get("snippet") or "") for h in hits)
+        entry = [dict(h) for h in hits]
+        with self._rcache_lock:
+            old = self._rcache.pop(key, None)
+            if old is not None:
+                self._rcache_bytes -= self._entry_bytes(old)
+            self._rcache[key] = entry
+            self._rcache_bytes += size
+            while len(self._rcache) > self._rcache_cap:
+                _, ev = self._rcache.popitem(last=False)
+                self._rcache_bytes -= self._entry_bytes(ev)
+
+    @staticmethod
+    def _entry_bytes(hits: list) -> int:
+        return 96 + sum(64 + len(h.get("snippet") or "") for h in hits)
+
+    def attach_cache_peers(self, clients: Sequence) -> None:
+        """Attach sibling front ends' SocketSearchClient handles (built
+        with result_cache=True) for fleet-wide sharing: a local miss
+        probes each peer's cache before computing, and a local fill is
+        pushed to every peer fire-and-forget. Peers that never negotiated
+        FLAG_RESULT_CACHE degrade to no-ops per the transport contract."""
+        with self._rcache_lock:
+            self._rcache_peers = list(clients)
+
+    def _peer_lookup(self, key: tuple) -> Optional[list]:
+        """Probe attached peers for a miss; a hit is re-formatted against
+        the LOCAL store (same corpus fleet-wide, so byte-identical) and
+        inserted locally so the next repeat stays in-process."""
+        with self._rcache_lock:
+            peers = list(self._rcache_peers)
+        if not peers:
+            return None
+        text, k, nprobe, store_gen, index_gen = key
+        for peer in peers:
+            try:
+                got = peer.cache_lookup(text, k=k, nprobe=nprobe,
+                                        store_gen=store_gen,
+                                        index_gen=index_gen)
+            except Exception:
+                continue         # a broken peer never breaks a query
+            if got is None:
+                continue
+            scores, ids = got
+            hits = self._format(scores[0], ids[0])
+            self._result_cache_put(key, hits)
+            return hits
+        return None
+
+    def _peer_put(self, key: Optional[tuple], hits: list) -> None:
+        if key is None:
+            return
+        with self._rcache_lock:
+            peers = list(self._rcache_peers)
+        if not peers:
+            return
+        text, k, nprobe, store_gen, index_gen = key
+        scores = np.full((k,), -np.inf, np.float32)
+        ids = np.full((k,), -1, np.int64)
+        for i, h in enumerate(hits[:k]):
+            scores[i] = h["score"]
+            ids[i] = h["page_id"]
+        for peer in peers:
+            try:
+                peer.cache_put(text, k=k, nprobe=nprobe,
+                               store_gen=store_gen, index_gen=index_gen,
+                               scores=scores, ids=ids)
+            except Exception:
+                continue
+
+    # wire-facing helpers (infer/server.py T_CACHE_LOOKUP / T_CACHE_PUT):
+    # operate on the raw [1, k] score/id arrays the RESULT frame ships
+    def _result_cache_wire_get(self, ck) -> Optional[tuple]:
+        """CacheKey probe from a peer. Returns ([1,k] scores, [1,k] ids)
+        on a hit, None on a miss / disabled / generation mismatch. Never
+        computes — a probe is cheaper than the shed it would replace."""
+        if self._rcache_cap <= 0 or not self._rcache_fleet:
+            return None
+        key = (self._normalize(ck.query), ck.k, int(ck.nprobe),
+               ck.store_gen, ck.index_gen)
+        hits = self._result_cache_get(key)
+        if hits is None:
+            return None
+        scores = np.full((1, ck.k), -np.inf, np.float32)
+        ids = np.full((1, ck.k), -1, np.int64)
+        for i, h in enumerate(hits[:ck.k]):
+            scores[0, i] = h["score"]
+            ids[0, i] = h["page_id"]
+        return scores, ids
+
+    def _result_cache_wire_put(self, ck, scores: np.ndarray,
+                               ids: np.ndarray) -> bool:
+        """CacheKey fill from a peer. The generations in the key are
+        validated against the LIVE view — a stale push (peer behind a
+        refresh) is silently dropped, never inserted under a reachable
+        key. Formatting runs against the local store: same corpus
+        fleet-wide, so the entry is byte-identical to a local fill."""
+        if self._rcache_cap <= 0 or not self._rcache_fleet:
+            return False
+        live = self._result_cache_key(ck.query, ck.k, ck.nprobe or None)
+        if live is None:
+            return False
+        if (live[3], live[4]) != (ck.store_gen, ck.index_gen):
+            return False         # stale generations: drop
+        key = (self._normalize(ck.query), ck.k, int(ck.nprobe),
+               ck.store_gen, ck.index_gen)
+        self._result_cache_put(
+            key, self._format(np.asarray(scores).reshape(-1),
+                              np.asarray(ids).reshape(-1)))
+        return True
 
     def _embed_queries_cached(self, queries: Sequence[str]) -> np.ndarray:
         """[n] texts -> [n, D] fp32 host query vectors, through the LRU
@@ -1507,6 +1736,23 @@ class SearchService:
             transport.update(self._fanout.stats())
         if transport:
             rec["transport"] = transport
+        if self._rcache_cap > 0:
+            # generation-keyed result cache (docs/SERVING.md "Result
+            # cache") — emitted ONLY when the feature is on, so the
+            # default record shape stays byte-stable
+            rhits = self.result_cache_hits
+            rmiss = self.result_cache_misses
+            with self._rcache_lock:
+                entries = len(self._rcache)
+                rbytes = self._rcache_bytes
+            rec["result_cache"] = {
+                "hits": rhits, "misses": rmiss,
+                "hit_rate": round(rhits / (rhits + rmiss), 4)
+                if (rhits + rmiss) else 0.0,
+                "entries": entries, "bytes": rbytes,
+                "capacity": self._rcache_cap,
+                "fleet": self._rcache_fleet,
+            }
         if self._serve_index != "exact":
             # ANN counters + the active index config (the PR 3
             # cache-counter pattern: flat keys, always present when the
@@ -1579,12 +1825,14 @@ class SearchService:
         self.search_many(["warmup"], k=k)
         lat = LatencyStats()
         cap, self._cache_cap = self._cache_cap, 0
+        rcap, self._rcache_cap = self._rcache_cap, 0
         try:
             for _ in range(max(1, timing_iters)):
                 with lat.timed():
                     self.search_many(["warmup"], k=k)
         finally:
             self._cache_cap = cap
+            self._rcache_cap = rcap
         self.warm_latency_ms = lat.percentile_ms(50)
 
     def search(self, query: str, k: Optional[int] = None,
@@ -1614,13 +1862,32 @@ class SearchService:
         "Network front end")."""
         if deadline is None:
             deadline = self.default_deadline(deadline_ms)
+        # result-cache probe at the admission door (docs/SERVING.md
+        # "Result cache"): a repeat answers BEFORE admission, so a hit
+        # can never be shed and never consumes a micro-batch bucket
+        # slot — the generation-qualified key makes a stale hit
+        # impossible, not merely unlikely
+        rkey = self._result_cache_key(query, k, nprobe)
+        if rkey is not None:
+            t0 = time.perf_counter()
+            hits = self._result_cache_get(rkey, count=False)
+            if hits is None:
+                hits = self._peer_lookup(rkey)
+            if hits is not None:
+                self._m_rcache_hits.inc()
+                self._m_requests.inc()
+                self._m_latency.observe(
+                    (time.perf_counter() - t0) * 1000.0)
+                return hits
+            self._m_rcache_misses.inc()
         # admission happens BEFORE the queue: a shed request never
         # consumes queue capacity or a bucket slot (raises out of here)
         self._admit(deadline)
         b = self._batcher
         if b is None:
             return self.search_many([query], k=k, nprobe=nprobe,
-                                    deadline=deadline)[0]
+                                    deadline=deadline,
+                                    _probe_cache=False)[0]
         t0 = time.perf_counter()
         try:
             with self.tracer.trace("search",
@@ -1641,7 +1908,7 @@ class SearchService:
 
     def search_many(self, queries: Sequence[str], k: Optional[int] = None,
                     nprobe: Optional[int] = None,
-                    *, _record: bool = True,
+                    *, _record: bool = True, _probe_cache: bool = True,
                     deadline: Optional[float] = None) -> List[List[Dict]]:
         """Vectorized multi-query search: one result list per query, in
         order. Queries fill the compiled `query_batch` bucket (larger lists
@@ -1659,6 +1926,24 @@ class SearchService:
         n = len(queries)
         if n == 0:
             return []
+        # result-cache shortcut for direct callers (`_record` — batcher
+        # dispatches and search()'s delegated misses skip the re-probe):
+        # an ALL-hit batch answers without embedding or scanning anything;
+        # a partial batch recomputes whole (one dispatch either way) and
+        # only the true misses count as misses
+        if _record and _probe_cache and self._rcache_cap > 0:
+            t0 = time.perf_counter()
+            cached = [self._result_cache_get(
+                self._result_cache_key(q, k, nprobe), count=False)
+                for q in queries]
+            miss_n = sum(1 for c in cached if c is None)
+            if miss_n == 0:
+                self._m_rcache_hits.inc(n)
+                self._m_requests.inc(n)
+                self._m_latency.observe(
+                    (time.perf_counter() - t0) * 1000.0, n=n)
+                return cached
+            self._m_rcache_misses.inc(miss_n)
         # ONE view for the whole call (docs/UPDATES.md): a refresh() swap
         # mid-call cannot mix generations inside a result set — this
         # dispatch finishes on the view it captured, the next one sees the
@@ -1702,7 +1987,17 @@ class SearchService:
         else:
             best_s, best_i, _ = self._topk_view(view, qv, n, k, nprobe)
         with self._stage("format"):
-            return [self._format(best_s[i], best_i[i]) for i in range(n)]
+            out = [self._format(best_s[i], best_i[i]) for i in range(n)]
+        if self._rcache_cap > 0:
+            # fill keyed against the CAPTURED view's generations: a
+            # refresh that swapped mid-compute files this result under
+            # the old (now unreachable) key, so a stale fill can never
+            # answer a post-swap probe — staleness-zero by construction
+            for q, hits in zip(queries, out):
+                key = self._result_cache_key(q, k, nprobe, view=view)
+                self._result_cache_put(key, hits)
+                self._peer_put(key, hits)
+        return out
 
     def topk_vectors(self, qv: np.ndarray, k: Optional[int] = None,
                      nprobe: Optional[int] = None,

@@ -41,9 +41,9 @@ import numpy as np
 
 from dnn_page_vectors_tpu.infer import transport
 from dnn_page_vectors_tpu.infer.transport import (
-    DeadlineExceeded, FrameError, FLAG_WIRE_COMPRESS, T_HELLO, T_QUERY,
-    T_RESULT, T_RESULT_C, T_SHED, T_ERROR, T_VQUERY, T_VQUERY_PUT,
-    T_VQUERY_REF)
+    DeadlineExceeded, FrameError, FLAG_RESULT_CACHE, FLAG_WIRE_COMPRESS,
+    T_CACHE_LOOKUP, T_CACHE_PUT, T_HELLO, T_QUERY, T_RESULT, T_RESULT_C,
+    T_SHED, T_ERROR, T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF)
 
 
 def parse_listen(listen: str) -> Tuple[str, int]:
@@ -84,6 +84,11 @@ class SearchServer:
         # every connection negotiates down to the raw frames
         self._compress = bool(getattr(serve_cfg, "wire_compress", True)
                               if serve_cfg is not None else True)
+        # fleet result-cache sharing (docs/SERVING.md "Result cache"):
+        # advertised only when the service actually runs the cache —
+        # a peer that negotiates the flag gets CACHE_LOOKUP / CACHE_PUT
+        # answered from / into the service's generation-keyed cache
+        self._rcache = bool(getattr(svc, "_rcache_fleet", False))
         self._executor = ThreadPoolExecutor(
             max_workers=executor_workers,
             thread_name_prefix="serve-socket")
@@ -195,12 +200,46 @@ class SearchServer:
                 svc._m_wire_raw.inc(actual)
                 if ftype == T_HELLO:
                     want = transport.decode_hello(payload)
-                    flags = want & (FLAG_WIRE_COMPRESS if self._compress
-                                    else 0)
+                    mask = ((FLAG_WIRE_COMPRESS if self._compress else 0)
+                            | (FLAG_RESULT_CACHE if self._rcache else 0))
+                    flags = want & mask
                     if flags & FLAG_WIRE_COMPRESS and slots is None:
                         slots = {}
                     await self._write(writer, T_HELLO,
                                       transport.encode_hello(flags))
+                    continue
+                if ftype == T_CACHE_LOOKUP and flags & FLAG_RESULT_CACHE:
+                    # pure probe: a hit answers straight from the
+                    # generation-keyed cache (no admission, no bucket
+                    # slot), a miss answers SHED_CACHE_MISS — the peer
+                    # falls back to computing locally, never errors
+                    ck = transport.decode_cache_lookup(payload)
+                    got = svc._result_cache_wire_get(ck)
+                    if got is None:
+                        await self._write(writer, T_SHED,
+                                          transport.encode_shed(
+                                              ck.req_id,
+                                              transport.SHED_CACHE_MISS,
+                                              "cache_miss"))
+                    elif flags & FLAG_WIRE_COMPRESS:
+                        await self._write(
+                            writer, T_RESULT_C,
+                            transport.encode_result_c(ck.req_id, got[0],
+                                                      got[1]),
+                            raw_len=transport.result_raw_bytes(
+                                *got[0].shape))
+                    else:
+                        await self._write(writer, T_RESULT,
+                                          transport.encode_result(
+                                              ck.req_id, got[0], got[1]))
+                    continue
+                if ftype == T_CACHE_PUT and flags & FLAG_RESULT_CACHE:
+                    # fire-and-forget fill: NO response frame (the wire
+                    # contract — the sender never reads one). The service
+                    # validates the key's generations against its live
+                    # view and silently drops a stale push.
+                    ck, pscores, pids = transport.decode_cache_put(payload)
+                    svc._result_cache_wire_put(ck, pscores, pids)
                     continue
                 if ftype in (T_QUERY, T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF):
                     if self._draining:
@@ -321,6 +360,25 @@ class SearchServer:
         request into the windowed serving instruments exactly once."""
         svc = self.svc
         with svc.tracer.use(root):
+            # result-cache probe at the admission door (docs/SERVING.md
+            # "Result cache"): a repeated text query answers before
+            # _admit can shed it or a bucket slot is consumed
+            if not vectors and n == 1:
+                rkey = svc._result_cache_key(req.queries[0], req.k or None,
+                                             nprobe)
+                if rkey is not None:
+                    t0 = time.perf_counter()
+                    hits = svc._result_cache_get(rkey, count=False)
+                    if hits is None:
+                        hits = svc._peer_lookup(rkey)
+                    if hits is not None:
+                        svc._m_rcache_hits.inc()
+                        svc._m_requests.inc()
+                        svc._m_latency.observe(
+                            (time.perf_counter() - t0) * 1000.0)
+                        scores, ids = _results_to_arrays([hits], k)
+                        return scores, ids, 0
+                    svc._m_rcache_misses.inc()
             # admission control at the door (raises DeadlineExceeded;
             # already counted + evented by _admit)
             svc._admit(deadline)

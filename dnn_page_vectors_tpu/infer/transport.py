@@ -58,7 +58,25 @@ compressing and raw peers interoperate on one gateway):
                  worker acks with its own T_REFRESH carrying the
                  generation it now serves
 
-Negotiation: capability flags (FLAG_WIRE_COMPRESS) are advertised by
+Fleet result-cache extensions (negotiated via FLAG_RESULT_CACHE — see
+docs/SERVING.md "Result cache"):
+
+    T_CACHE_LOOKUP  a pure result-cache probe: the generation-qualified
+                    cache key (normalized query text, k, nprobe, store
+                    generation, index generation). A hit answers with a
+                    standard T_RESULT/T_RESULT_C; a miss answers T_SHED
+                    with code SHED_CACHE_MISS — the probe never admits,
+                    queues, or computes anything.
+    T_CACHE_PUT     share one computed result into the peer's cache:
+                    the same key plus the [k] scores/ids row. Fire-and-
+                    forget (NO response frame — the receiver validates
+                    the generations against its own live view and
+                    silently drops a stale or unwanted entry), so a PUT
+                    can ride ahead of the next request on one ordered
+                    connection without desynchronizing request/response.
+
+Negotiation: capability flags (FLAG_WIRE_COMPRESS, FLAG_RESULT_CACHE)
+are advertised by
 the connecting peer — a worker in its REGISTER frame, a client in a
 leading T_HELLO — and confirmed by the accepting side with a T_HELLO
 carrying the agreed intersection. Nobody sends a compressed or interned
@@ -100,13 +118,16 @@ T_VQUERY_PUT = 10             # VQUERY + intern block into a cache slot
 T_VQUERY_REF = 11             # VQUERY referencing an interned slot
 T_HELLO = 12                  # capability exchange (flags byte)
 T_REFRESH = 13                # view-refresh control / generation ack
+T_CACHE_LOOKUP = 14           # result-cache probe (key -> RESULT / miss)
+T_CACHE_PUT = 15              # result-cache share (key + one result row)
 
 _TYPES = {T_QUERY, T_VQUERY, T_RESULT, T_SHED, T_ERROR, T_REGISTER,
           T_HEARTBEAT, T_BYE, T_RESULT_C, T_VQUERY_PUT, T_VQUERY_REF,
-          T_HELLO, T_REFRESH}
+          T_HELLO, T_REFRESH, T_CACHE_LOOKUP, T_CACHE_PUT}
 
 # capability flags (REGISTER / HELLO negotiation)
 FLAG_WIRE_COMPRESS = 0x01     # peer speaks T_RESULT_C + T_VQUERY_PUT/REF
+FLAG_RESULT_CACHE = 0x02      # peer speaks T_CACHE_LOOKUP / T_CACHE_PUT
 
 # per-connection intern table size: a protocol constant, so the sender's
 # slot assignment (a ring over these slots) and the receiver's passive
@@ -117,6 +138,7 @@ WIRE_SLOTS = 64
 SHED_DEADLINE = 1             # deadline expired / cannot be met
 SHED_QUEUE = 2                # admission queue budget exceeded
 SHED_DRAINING = 3             # front end shutting down (graceful drain)
+SHED_CACHE_MISS = 4           # T_CACHE_LOOKUP probe missed (not an error)
 
 _QUERY_HEAD = struct.Struct("!QdiiH")     # req id, deadline ms, k, nprobe, nq
 _VQUERY_HEAD = struct.Struct("!QdiiHH")   # ... + n, dim
@@ -128,6 +150,9 @@ _REGISTER_HEAD2 = struct.Struct("!IIQBQ")  # ... + flags, store generation
 _SLOT = struct.Struct("!H")               # intern slot id
 _HELLO_HEAD = struct.Struct("!B")         # capability flags
 _REFRESH_HEAD = struct.Struct("!Q")       # store generation
+# result-cache key head: req id, k, nprobe, store generation, index
+# generation (signed; -1 = the view serves without an index), text len
+_CACHE_HEAD = struct.Struct("!QiiQqH")
 
 _REQ_IDS = itertools.count(1)
 
@@ -536,6 +561,90 @@ def decode_refresh(payload: bytes) -> int:
     return _REFRESH_HEAD.unpack(payload)[0]
 
 
+# -- fleet result cache (docs/SERVING.md "Result cache") --------------------
+
+@dataclass(frozen=True)
+class CacheKey:
+    """The generation-qualified result-cache key as it travels the wire:
+    identical to the service-side key tuple, so a LOOKUP on front end B
+    addresses exactly the entry a PUT from front end A created."""
+    req_id: int
+    k: int
+    nprobe: int               # 0 = the server default
+    store_gen: int
+    index_gen: int            # -1 = the view serves without an index
+    query: str                # whitespace-normalized text
+
+
+def _encode_cache_key(req_id: int, query: str, k: int, nprobe: int,
+                      store_gen: int, index_gen: int) -> bytes:
+    raw = query.encode("utf-8")
+    if len(raw) > 0xFFFF:
+        raise ValueError("cache-key query text exceeds 65535 utf-8 bytes")
+    return _CACHE_HEAD.pack(req_id, int(k), int(nprobe), int(store_gen),
+                            int(index_gen), len(raw)) + raw
+
+
+def _decode_cache_key(payload: bytes, what: str) -> Tuple[CacheKey, int]:
+    """-> (key, offset past the key); FrameError on truncation."""
+    if len(payload) < _CACHE_HEAD.size:
+        raise FrameError(f"{what} frame shorter than its fixed header")
+    req_id, k, nprobe, sgen, igen, ln = _CACHE_HEAD.unpack_from(payload)
+    off = _CACHE_HEAD.size
+    if off + ln > len(payload):
+        raise FrameError(f"{what} frame truncated inside the query text")
+    try:
+        query = payload[off: off + ln].decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise FrameError(f"{what} query text is not utf-8: {e}") from None
+    return CacheKey(req_id, k, nprobe, sgen, igen, query), off + ln
+
+
+def encode_cache_lookup(req_id: int, query: str, k: int, nprobe: int,
+                        store_gen: int, index_gen: int) -> bytes:
+    return _encode_cache_key(req_id, query, k, nprobe, store_gen, index_gen)
+
+
+def decode_cache_lookup(payload: bytes) -> CacheKey:
+    key, off = _decode_cache_key(payload, "cache lookup")
+    if off != len(payload):
+        raise FrameError(f"{len(payload) - off} trailing bytes after a "
+                         "cache-lookup key")
+    return key
+
+
+def encode_cache_put(req_id: int, query: str, k: int, nprobe: int,
+                     store_gen: int, index_gen: int, scores: np.ndarray,
+                     ids: np.ndarray) -> bytes:
+    """One computed result row for the key: scores [k] f32 + ids [k] i64,
+    -1-id padded past the real hit count (the same wire convention as a
+    RESULT frame)."""
+    scores = np.ascontiguousarray(scores, dtype="<f4").reshape(-1)
+    ids = np.ascontiguousarray(ids, dtype="<i8").reshape(-1)
+    if scores.shape[0] != k or ids.shape[0] != k:
+        raise ValueError(f"cache-put row must be [{k}] scores + [{k}] ids, "
+                         f"got {scores.shape[0]}/{ids.shape[0]}")
+    return (_encode_cache_key(req_id, query, k, nprobe, store_gen,
+                              index_gen) + scores.tobytes() + ids.tobytes())
+
+
+def decode_cache_put(payload: bytes
+                     ) -> Tuple[CacheKey, np.ndarray, np.ndarray]:
+    """-> (key, scores [k] f32, ids [k] i64); the arrays alias the
+    payload (zero-copy). Truncated or trailing bytes REJECT."""
+    key, off = _decode_cache_key(payload, "cache put")
+    if key.k <= 0:
+        raise FrameError(f"cache-put k {key.k} must be positive")
+    want = key.k * (4 + 8)
+    if len(payload) - off != want:
+        raise FrameError(f"cache-put row carries {len(payload) - off} "
+                         f"bytes for k={key.k} ({want} expected)")
+    scores = np.frombuffer(payload, dtype="<f4", count=key.k, offset=off)
+    ids = np.frombuffer(payload, dtype="<i8", count=key.k,
+                        offset=off + key.k * 4)
+    return key, scores, ids
+
+
 # ---------------------------------------------------------------------------
 # framing over sync sockets (partition RPC hop, client library)
 # ---------------------------------------------------------------------------
@@ -719,15 +828,23 @@ class SocketSearchClient:
     blocks intern into per-connection slots (PUT once, 2-byte REF after)
     and results arrive as T_RESULT_C — both lossless. A server that does
     not answer the HELLO (a pre-compression peer closes on the unknown
-    frame) is remembered and the client reconnects raw."""
+    frame) is remembered and the client reconnects raw.
+
+    With `result_cache` the HELLO also advertises FLAG_RESULT_CACHE;
+    once the server confirms, `cache_lookup()` probes its result cache
+    and `cache_put()` shares a computed row into it — the peering calls
+    the fleet cache rides on (docs/SERVING.md "Result cache"). Against a
+    server that does not confirm the flag, both degrade to no-ops."""
 
     def __init__(self, host: str, port: int, deadline_ms: float = 0.0,
-                 timeout_s: float = 30.0, compress: bool = True):
+                 timeout_s: float = 30.0, compress: bool = True,
+                 result_cache: bool = False):
         self.host = host
         self.port = int(port)
         self.deadline_ms = float(deadline_ms)
         self.timeout_s = float(timeout_s)
         self.compress = bool(compress)
+        self.result_cache = bool(result_cache)
         self._local = threading.local()
         self._lock = threading.Lock()
         self._conns: List[socket.socket] = []   # guarded-by: _lock
@@ -745,11 +862,13 @@ class SocketSearchClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sender = FrameSender(sock)
         flags = 0
+        want = ((FLAG_WIRE_COMPRESS if self.compress else 0)
+                | (FLAG_RESULT_CACHE if self.result_cache else 0))
         with self._lock:
-            attempt_hello = self.compress and not self._legacy_server
+            attempt_hello = bool(want) and not self._legacy_server
         if attempt_hello:
             try:
-                sender.send(T_HELLO, encode_hello(FLAG_WIRE_COMPRESS))
+                sender.send(T_HELLO, encode_hello(want))
                 frame = read_frame(sock)
             except (OSError, FrameError):
                 frame = None
@@ -870,6 +989,52 @@ class SocketSearchClient:
         payload = encode_vquery(req_id, block, k=k or 0, nprobe=nprobe or 0,
                                 deadline_ms=dl)
         return self._roundtrip(T_VQUERY, (payload,), req_id)
+
+    # -- fleet result-cache peering (docs/SERVING.md "Result cache") -------
+    def cache_lookup(self, query: str, k: int, nprobe: int,
+                     store_gen: int, index_gen: int
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Probe the server's result cache for the generation-qualified
+        key: (scores [1, k], ids [1, k]) on a hit, None on a miss or on
+        a connection that never negotiated FLAG_RESULT_CACHE. A probe
+        never admits, queues, or computes anything server-side."""
+        _, _, flags, _ = self._conn()
+        if not flags & FLAG_RESULT_CACHE:
+            return None
+        req_id = next_request_id()
+        payload = encode_cache_lookup(req_id, query, k=k, nprobe=nprobe,
+                                      store_gen=store_gen,
+                                      index_gen=index_gen)
+        try:
+            scores, ids, _ = self._roundtrip(T_CACHE_LOOKUP, (payload,),
+                                             req_id)
+        except DeadlineExceeded:
+            return None           # SHED_CACHE_MISS: a miss, not a shed
+        return scores, ids
+
+    def cache_put(self, query: str, k: int, nprobe: int, store_gen: int,
+                  index_gen: int, scores: np.ndarray,
+                  ids: np.ndarray) -> bool:
+        """Share one computed [k] result row into the server's cache.
+        Fire-and-forget: no response frame rides back (the server
+        validates the generations and silently drops a stale entry), so
+        the call costs one send on the ordered connection. True when the
+        frame left; False when the flag was never negotiated or the
+        connection broke (the entry just doesn't share — never an
+        error)."""
+        sock, sender, flags, _ = self._conn()
+        if not flags & FLAG_RESULT_CACHE:
+            return False
+        payload = encode_cache_put(next_request_id(), query, k=k,
+                                   nprobe=nprobe, store_gen=store_gen,
+                                   index_gen=index_gen, scores=scores,
+                                   ids=ids)
+        try:
+            sender.send(T_CACHE_PUT, payload)
+        except OSError:
+            self._drop_local()
+            return False
+        return True
 
     def close(self) -> None:
         with self._lock:

@@ -99,7 +99,9 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
     batcher, RPC fan-out, and the socket round trip back."""
     ev0 = len(svc.registry.events()) if hasattr(svc, "registry") else 0
     mut0 = mutator.calls if mutator is not None else 0
-    transport0 = dict(svc.metrics().get("transport") or {})
+    m0 = svc.metrics()
+    transport0 = dict(m0.get("transport") or {})
+    rcache0 = dict(m0.get("result_cache") or {})
     sent = 0
     errors = 0
     sheds = 0
@@ -241,6 +243,20 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         if sheds:
             blk["client_sheds"] = sheds
         rec["transport"] = blk
+    rcache1 = m.get("result_cache")
+    if rcache1:
+        # result-cache block (docs/SERVING.md "Result cache"), ONLY when
+        # the feature is on: hit/miss counters are per-trial deltas
+        # against the trial-start snapshot; entries/bytes are end state
+        rhits = rcache1.get("hits", 0) - rcache0.get("hits", 0)
+        rmiss = rcache1.get("misses", 0) - rcache0.get("misses", 0)
+        rec["result_cache"] = {
+            "hits": rhits, "misses": rmiss,
+            "hit_rate": round(rhits / (rhits + rmiss), 4)
+            if (rhits + rmiss) else 0.0,
+            "entries": rcache1.get("entries", 0),
+            "bytes": rcache1.get("bytes", 0),
+        }
     if schedule_digest is not None:
         rec["schedule_digest"] = schedule_digest
     if mutator is not None:

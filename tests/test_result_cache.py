@@ -1,0 +1,498 @@
+"""Generation-keyed result cache + popularity tiering (docs/SERVING.md
+"Result cache"): a repeat query must serve from cache WITHOUT becoming
+stale — after an append + refresh() the same query must be byte-identical
+to a cold-cache oracle on every tested topology (local, partitioned
+P=2/R=2, socket fleet), because the generations live in the KEY and a
+refresh makes every old entry unreachable. Plus: LRU eviction under a
+small capacity, clear_cache() flushing everything with a `cache_cleared`
+event, the CACHE_LOOKUP/CACHE_PUT wire codec (round-trip + reject fuzz),
+fleet peering (a local miss served from a sibling's cache, fills pushed
+fire-and-forget, stale pushes dropped), a concurrent refresh hammer that
+must never surface a mixed-generation result, and the IVF popularity
+table driving stage_hot's hot-set ranking."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.infer import transport
+from dnn_page_vectors_tpu.infer.transport import (
+    FrameError, SocketSearchClient)
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+
+pytestmark = pytest.mark.rescache
+
+DIM = 32
+SHARD = 50
+NSHARDS = 6
+
+
+# ---------------------------------------------------------------------------
+# fixtures: synthetic store + model-free services (the test_net idiom —
+# the cache layer is exercised by a deterministic text -> vector stub)
+# ---------------------------------------------------------------------------
+
+def _fake_embed(queries):
+    """Deterministic text -> unit vector (no model): the text-keyed cache
+    path is exercised without a trained encoder."""
+    out = np.zeros((len(queries), DIM), np.float32)
+    for i, q in enumerate(queries):
+        r = np.random.default_rng(
+            np.frombuffer(q.encode()[:8].ljust(8, b"\0"),
+                          np.uint64)[0] % (2 ** 32))
+        v = r.standard_normal(DIM).astype(np.float32)
+        out[i] = v / np.linalg.norm(v)
+    return out
+
+
+class _StubCorpus:
+    def page_text(self, i):
+        return f"page {i}"
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _fresh_store(tmp_path):
+    sdir = str(tmp_path / "store")
+    rng = np.random.default_rng(0)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    store.ensure_model_step(1)          # appends require a stamped store
+    for si in range(NSHARDS):
+        v = rng.standard_normal((SHARD, DIM)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(si * SHARD, (si + 1) * SHARD,
+                                        dtype=np.int64), v)
+    return VectorStore(sdir)
+
+
+def _service(store, mesh, **serve_over):
+    import dataclasses
+
+    from dnn_page_vectors_tpu.infer.partition_host import MeshEmbedder
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM})
+    if serve_over:
+        cfg = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    **serve_over))
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                        preload_hbm_gb=4.0)
+    svc._embed_queries_cached = _fake_embed
+    svc.corpus = _StubCorpus()
+    return svc
+
+
+def _append_planted(sdir, query, n_new=10):
+    """Commit one generation whose FIRST row is the query's own vector:
+    post-refresh, the query's top-1 must be the planted id — so a stale
+    cached answer is observably wrong, not merely old."""
+    store = VectorStore(sdir)
+    base = store.next_page_id()
+    vecs = np.random.default_rng(base).standard_normal(
+        (n_new, DIM)).astype(np.float32)
+    vecs[0] = _fake_embed([query])[0]
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    w = store.begin_generation()
+    w.write_shard(np.arange(base, base + n_new, dtype=np.int64), vecs)
+    w.commit()
+    return base
+
+
+def _ids(hits):
+    return tuple(r["page_id"] for r in hits)
+
+
+# ---------------------------------------------------------------------------
+# staleness-zero pins: local, partitioned, socket fleet
+# ---------------------------------------------------------------------------
+
+def test_local_hit_then_staleness_zero_after_refresh(tmp_path, mesh):
+    store = _fresh_store(tmp_path)
+    sdir = store.directory
+    svc = _service(store, mesh, result_cache=True)
+    q = "zipf head query"
+    first = svc.search(q, k=10)
+    assert svc.result_cache_misses == 1 and svc.result_cache_hits == 0
+    again = svc.search(q, k=10)
+    assert again == first                    # served from cache, identical
+    assert svc.result_cache_hits == 1
+    met = svc.metrics()["result_cache"]
+    assert met["hits"] == 1 and met["misses"] == 1
+    assert met["hit_rate"] == 0.5 and met["entries"] >= 1
+    assert met["bytes"] > 0 and met["capacity"] == 4096
+
+    planted = _append_planted(sdir, q)
+    info = svc.refresh()
+    assert info["new_docs"] == 10
+    after = svc.search(q, k=10)              # generation bumped: NOT a hit
+    assert svc.result_cache_misses == 2
+    oracle = _service(VectorStore(sdir), mesh)   # cold, cache off
+    want = oracle.search(q, k=10)
+    assert after == want                     # byte-identical to cold cache
+    assert after[0]["page_id"] == planted    # the new row actually ranks
+    assert _ids(after) != _ids(first)
+    # the repeat on the NEW generation hits again
+    assert svc.search(q, k=10) == want
+    assert svc.result_cache_hits == 2
+    oracle.close()
+    svc.close()
+
+
+def test_staleness_zero_partitioned_p2_r2(tmp_path, mesh):
+    store = _fresh_store(tmp_path)
+    sdir = store.directory
+    svc = _service(store, mesh, result_cache=True, partitions=2,
+                   replicas=2)
+    q = "partitioned zipf query"
+    first = svc.search(q, k=10)
+    assert svc.search(q, k=10) == first
+    assert svc.result_cache_hits == 1
+    planted = _append_planted(sdir, q)
+    svc.refresh()
+    after = svc.search(q, k=10)
+    oracle = _service(VectorStore(sdir), mesh, partitions=2, replicas=2)
+    want = oracle.search(q, k=10)
+    assert after == want
+    assert after[0]["page_id"] == planted
+    oracle.close()
+    svc.close()
+
+
+def test_staleness_zero_over_socket_fleet(tmp_path, mesh):
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    store = _fresh_store(tmp_path)
+    sdir = store.directory
+    svc = _service(store, mesh, result_cache=True, result_cache_fleet=True)
+    srv = serve_in_background(svc)
+    client = SocketSearchClient(srv.host, srv.port, result_cache=True)
+    try:
+        q = "socket zipf query"
+        first = client.search(q, k=10)
+        assert svc.result_cache_misses >= 1
+        assert client.search(q, k=10) == first   # served at the door
+        assert svc.result_cache_hits >= 1
+
+        # the raw CACHE_LOOKUP probe answers the primed key...
+        key = svc._result_cache_key(q, 10, None)
+        got = client.cache_lookup(q, k=10, nprobe=key[2],
+                                  store_gen=key[3], index_gen=key[4])
+        assert got is not None
+        np.testing.assert_array_equal(
+            got[1][0][:len(first)], [r["page_id"] for r in first])
+        # ...and a probe for generations nobody served is a miss (None),
+        # not an error
+        assert client.cache_lookup(q, k=10, nprobe=key[2],
+                                   store_gen=key[3] + 7,
+                                   index_gen=key[4]) is None
+
+        planted = _append_planted(sdir, q)
+        svc.refresh()
+        after = client.search(q, k=10)
+        oracle = _service(VectorStore(sdir), mesh)
+        want = oracle.search(q, k=10)
+        assert [r["page_id"] for r in after] \
+            == [r["page_id"] for r in want]
+        np.testing.assert_allclose([r["score"] for r in after],
+                                   [r["score"] for r in want], atol=1e-3)
+        assert after[0]["page_id"] == planted
+        # a stale PUT (pre-refresh generations, a query nobody cached)
+        # is silently dropped: the same stale-key probe stays a miss
+        assert client.cache_put("stale put query", k=10, nprobe=key[2],
+                                store_gen=key[3], index_gen=key[4],
+                                scores=np.zeros(10, np.float32),
+                                ids=np.arange(10, dtype=np.int64))
+        time.sleep(0.3)                      # fire-and-forget: let it land
+        assert client.cache_lookup("stale put query", k=10,
+                                   nprobe=key[2], store_gen=key[3],
+                                   index_gen=key[4]) is None
+        # a LIVE-generation PUT for a never-searched query is accepted
+        # and round-trips through LOOKUP
+        key2 = svc._result_cache_key("planted put query", 10, None)
+        ps = np.linspace(0.9, 0.1, 10).astype(np.float32)
+        pi = np.arange(10, dtype=np.int64)
+        assert client.cache_put("planted put query", k=10, nprobe=key2[2],
+                                store_gen=key2[3], index_gen=key2[4],
+                                scores=ps, ids=pi)
+        got2 = None
+        deadline = time.time() + 5.0
+        while got2 is None and time.time() < deadline:
+            time.sleep(0.02)
+            got2 = client.cache_lookup("planted put query", k=10,
+                                       nprobe=key2[2], store_gen=key2[3],
+                                       index_gen=key2[4])
+        assert got2 is not None, "live-generation CACHE_PUT never landed"
+        np.testing.assert_array_equal(got2[1][0], pi)
+        oracle.close()
+    finally:
+        client.close()
+        srv.close()
+        svc.close()
+
+
+def test_client_without_negotiation_degrades_to_noop(tmp_path, mesh):
+    """A peer that never negotiated FLAG_RESULT_CACHE gets no cache
+    frames: lookup is None, put is False, and a caching client against a
+    non-caching server degrades the same way (mixed-fleet interop)."""
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    store = _fresh_store(tmp_path)
+    svc = _service(store, mesh, result_cache=True, result_cache_fleet=True)
+    srv = serve_in_background(svc)
+    plain = SocketSearchClient(srv.host, srv.port)   # no result_cache
+    try:
+        assert plain.cache_lookup("q", k=10, nprobe=0, store_gen=0,
+                                  index_gen=-1) is None
+        assert not plain.cache_put("q", k=10, nprobe=0, store_gen=0,
+                                   index_gen=-1,
+                                   scores=np.zeros(10, np.float32),
+                                   ids=np.zeros(10, np.int64))
+    finally:
+        plain.close()
+        srv.close()
+        svc.close()
+    # caching client, non-fleet server: HELLO intersects the flag away
+    svc2 = _service(_fresh_store(tmp_path / "b"), mesh, result_cache=True)
+    srv2 = serve_in_background(svc2)
+    eager = SocketSearchClient(srv2.host, srv2.port, result_cache=True)
+    try:
+        assert eager.cache_lookup("q", k=10, nprobe=0, store_gen=0,
+                                  index_gen=-1) is None
+    finally:
+        eager.close()
+        srv2.close()
+        svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet peering: a local miss served from a sibling's cache
+# ---------------------------------------------------------------------------
+
+def test_peer_lookup_serves_local_miss_and_fills_propagate(tmp_path, mesh):
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    store_a = _fresh_store(tmp_path)
+    store_b = VectorStore(store_a.directory)         # same corpus fleet-wide
+    svc_a = _service(store_a, mesh, result_cache=True,
+                     result_cache_fleet=True)
+    svc_b = _service(store_b, mesh, result_cache=True,
+                     result_cache_fleet=True)
+    srv_b = serve_in_background(svc_b)
+    peer = SocketSearchClient(srv_b.host, srv_b.port, result_cache=True)
+    svc_a.attach_cache_peers([peer])
+    try:
+        q = "fleet shared query"
+        want = svc_b.search(q, k=10)                 # primes B's cache
+        got = svc_a.search(q, k=10)                  # A: local miss -> peer
+        assert _ids(got) == _ids(want)
+        assert [r["score"] for r in got] == [r["score"] for r in want]
+        assert svc_a.result_cache_hits == 1          # the peer hit counted
+        # the peer answer was inserted locally: the repeat stays in-process
+        key = svc_a._result_cache_key(q, 10, None)
+        assert svc_a._result_cache_get(key, count=False) is not None
+
+        # a query computed on A is pushed to B fire-and-forget
+        q2 = "fleet pushed query"
+        svc_a.search(q2, k=10)
+        key2 = svc_b._result_cache_key(q2, 10, None)
+        landed = None
+        deadline = time.time() + 5.0
+        while landed is None and time.time() < deadline:
+            time.sleep(0.02)
+            landed = svc_b._result_cache_get(key2, count=False)
+        assert landed is not None, "CACHE_PUT to the peer never landed"
+        assert _ids(landed) == _ids(svc_a.search(q2, k=10))
+    finally:
+        peer.close()
+        srv_b.close()
+        svc_b.close()
+        svc_a.close()
+
+
+# ---------------------------------------------------------------------------
+# LRU + clear_cache
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_and_clear_cache_event(tmp_path, mesh):
+    store = _fresh_store(tmp_path)
+    svc = _service(store, mesh, result_cache=True, result_cache_size=4)
+    queries = [f"distinct query {i}" for i in range(6)]
+    for q in queries:
+        svc.search(q, k=10)
+    met = svc.metrics()["result_cache"]
+    assert met["entries"] == 4 and met["capacity"] == 4
+    # the two OLDEST entries were evicted, the newest four are resident
+    for q in queries[:2]:
+        key = svc._result_cache_key(q, 10, None)
+        assert svc._result_cache_get(key, count=False) is None
+    for q in queries[2:]:
+        key = svc._result_cache_key(q, 10, None)
+        assert svc._result_cache_get(key, count=False) is not None
+    # a hit refreshes recency: re-touch the oldest survivor, insert one
+    # more, and the survivor outlives the entry that was ahead of it
+    svc.search(queries[2], k=10)
+    svc.search("one more query", k=10)
+    assert svc._result_cache_get(
+        svc._result_cache_key(queries[2], 10, None),
+        count=False) is not None
+    assert svc._result_cache_get(
+        svc._result_cache_key(queries[3], 10, None), count=False) is None
+
+    svc.clear_cache()
+    met = svc.metrics()["result_cache"]
+    assert met["entries"] == 0 and met["bytes"] == 0
+    evs = svc.registry.events("cache_cleared")
+    assert evs and evs[-1]["attrs"]["result_entries"] == 4
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# concurrent refresh hammer: no mixed-generation result, ever
+# ---------------------------------------------------------------------------
+
+def test_concurrent_refresh_hammer_never_serves_stale(tmp_path, mesh):
+    store = _fresh_store(tmp_path)
+    sdir = store.directory
+    svc = _service(store, mesh, result_cache=True)
+    queries = [f"hammer query {i}" for i in range(4)]
+    valid = {q: set() for q in queries}
+    oracle = _service(VectorStore(sdir), mesh)
+    for q in queries:
+        valid[q].add(_ids(oracle.search(q, k=10)))
+    oracle.close()
+    stop = threading.Event()
+    errors, observed = [], {q: set() for q in queries}
+
+    def hammer(q):
+        while not stop.is_set():
+            try:
+                observed[q].add(_ids(svc.search(q, k=10)))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            # throttle: cache hits are pure Python — an unthrottled spin
+            # starves the main thread's per-cycle oracle compile
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=hammer, args=(q,))
+               for q in queries]
+    for t in threads:
+        t.start()
+    for cycle in range(3):
+        _append_planted(sdir, queries[cycle % len(queries)], n_new=5)
+        svc.refresh()
+        oracle = _service(VectorStore(sdir), mesh)
+        for q in queries:
+            valid[q].add(_ids(oracle.search(q, k=10)))
+        oracle.close()
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, f"hammered search raised: {errors[:3]}"
+    for q in queries:
+        extra = observed[q] - valid[q]
+        assert not extra, (f"{q!r} served a result matching NO store "
+                           f"generation: {extra}")
+    # the hammer actually exercised the cache, and the final answer is
+    # the newest generation's cold-cache oracle
+    assert svc.result_cache_hits > 0
+    oracle = _service(VectorStore(sdir), mesh)
+    for q in queries:
+        assert svc.search(q, k=10) == oracle.search(q, k=10)
+    oracle.close()
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# wire codec: round-trip + reject
+# ---------------------------------------------------------------------------
+
+def test_cache_frame_codec_roundtrip_and_reject():
+    pay = transport.encode_cache_lookup(7, "què ry", k=10, nprobe=3,
+                                        store_gen=2, index_gen=-1)
+    ck = transport.decode_cache_lookup(pay)
+    assert (ck.req_id, ck.k, ck.nprobe) == (7, 10, 3)
+    assert (ck.store_gen, ck.index_gen, ck.query) == (2, -1, "què ry")
+    scores = np.linspace(1.0, 0.1, 10).astype(np.float32)
+    ids = np.arange(10, dtype=np.int64)
+    ids[-2:] = -1                            # padded past the hit count
+    ppay = transport.encode_cache_put(8, "q", k=10, nprobe=0, store_gen=1,
+                                      index_gen=4, scores=scores, ids=ids)
+    ck2, s2, i2 = transport.decode_cache_put(ppay)
+    assert ck2.req_id == 8 and ck2.index_gen == 4
+    np.testing.assert_array_equal(s2, scores)
+    np.testing.assert_array_equal(i2, ids)
+    # rejects: truncation, trailing bytes, short/long rows, bad k
+    with pytest.raises(FrameError):
+        transport.decode_cache_lookup(pay[:8])
+    with pytest.raises(FrameError):
+        transport.decode_cache_lookup(pay[:-1])
+    with pytest.raises(FrameError):
+        transport.decode_cache_lookup(pay + b"x")
+    with pytest.raises(FrameError):
+        transport.decode_cache_put(ppay[:-3])
+    with pytest.raises(FrameError):
+        transport.decode_cache_put(ppay + b"\0" * 4)
+    bad_k = transport._CACHE_HEAD.pack(9, 0, 0, 0, 0, 1) + b"q"
+    with pytest.raises(FrameError):
+        transport.decode_cache_put(bad_k)
+    with pytest.raises(ValueError):
+        transport.encode_cache_put(9, "q", k=10, nprobe=0, store_gen=0,
+                                   index_gen=0, scores=scores[:4], ids=ids)
+
+
+# ---------------------------------------------------------------------------
+# popularity tiering: measured scan counts rank the hot set
+# ---------------------------------------------------------------------------
+
+def test_popularity_counts_rank_stage_hot(tmp_path):
+    from dnn_page_vectors_tpu.config import MeshConfig
+    from dnn_page_vectors_tpu.index.ivf import IVFIndex
+    from dnn_page_vectors_tpu.parallel.mesh import make_mesh
+    rng = np.random.default_rng(3)
+    n, d, nclust = 600, 32, 12
+    centers = rng.normal(size=(nclust, d))
+    vecs = (centers[rng.integers(0, nclust, n)]
+            + 0.3 * rng.normal(size=(n, d))).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    store = VectorStore(str(tmp_path / "synth"), dim=d, shard_size=200,
+                        dtype="float16")
+    store.ensure_model_step(1)
+    for i in range(0, n, 200):
+        store.write_shard(i // 200, np.arange(i, min(i + 200, n)),
+                          vecs[i: i + 200])
+    mesh = make_mesh(MeshConfig(data=4))
+    idx = IVFIndex.build(store, mesh, nlist=8, iters=4, seed=0, pq_m=4)
+    assert idx.scan_counts.shape == (8,) and idx.scan_counts.sum() == 0
+    # a COLD table degrades to biggest-first and says so
+    budget = 3 * n * (idx.pq.m + 4) // 8       # room for ~2-3 lists
+    cold = idx.stage_hot(budget)
+    assert not cold["hot_by_popularity"]
+    assert 0 < cold["hot_lists"] < idx.nlist
+    size_order_lists = np.nonzero(idx._hot["lists"])[0]
+
+    # hammer ONE query at nprobe=1: its probed list dominates the window
+    q = vecs[5:6]
+    s_ref, ids_ref, _ = idx.search(q, k=10, nprobe=8, rerank=64)
+    for _ in range(50):
+        idx.search(q, k=10, nprobe=1, rerank=16)
+    hot_list = int(np.argmax(idx.scan_counts))
+    before = idx.scan_counts.copy()
+    hot = idx.stage_hot(budget)
+    assert hot["hot_by_popularity"]
+    assert idx._hot is None or idx._hot["lists"][hot_list], \
+        "the measured-hottest list was not staged"
+    if idx._hot is not None:
+        pop_order_lists = np.nonzero(idx._hot["lists"])[0]
+        assert hot_list in pop_order_lists
+    # the window decays: each restage halves the table
+    np.testing.assert_array_equal(idx.scan_counts, before >> 1)
+    # parity: popularity staging changes residency, never results
+    s_pop, ids_pop, _ = idx.search(q, k=10, nprobe=8, rerank=64)
+    np.testing.assert_array_equal(ids_pop, ids_ref)
+    np.testing.assert_allclose(s_pop, s_ref, atol=1e-3)
+    assert size_order_lists is not None      # both rankings exercised
