@@ -1963,6 +1963,92 @@ def run_net_worker() -> None:
         vclient.close()
         srv.close()
         svc.close()
+
+    # chaos_serve drill (docs/ROBUSTNESS.md "Availability drills";
+    # BENCH_CHAOS=0 skips): the self-healing pin priced on real loopback
+    # sockets. Phase 1 — tear the sole worker's connection under a query
+    # hammer and time kill -> rejoined + live again
+    # (chaos_recovery_seconds; the acceptance pin is <= 3x the heartbeat
+    # interval). Phase 2 — a seeded wire-fault schedule (torn frames,
+    # dup frames, drops, stalls) fires under the hammer; every answer
+    # must stay byte-identical to the in-process oracle
+    # (chaos_availability = answered/offered, chaos_errors pinned 0 —
+    # a mismatch counts as an error).
+    if os.environ.get("BENCH_CHAOS", "1") != "0":
+        from dnn_page_vectors_tpu.utils import faults as _faults
+        hb_s = 0.25
+        cfg = get_config("cdssm_toy", {
+            "model.out_dim": dim, "serve.partitions": 1,
+            "serve.replicas": 1, "serve.heartbeat_s": hb_s})
+        svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                            preload_hbm_gb=4.0)
+        # the never-faulted oracle: in-process answers BEFORE any
+        # gateway attaches — the wire must reproduce these exactly
+        oracle = [svc.topk_vectors(qvs[i:i + 1], k=kq)
+                  for i in range(distinct)]
+        gw = WorkerGateway(svc, heartbeat_s=hb_s)
+        svc.attach_gateway(gw)
+        w = PartitionWorker(cfg, sdir, ("127.0.0.1", gw.port), partition=0,
+                            partitions=1, replica=0, mesh=mesh)
+        _threading.Thread(target=w.run, daemon=True).start()
+        gw.wait_for_workers(1, timeout_s=60.0)
+        offered = answered = errors = sheds = 0
+
+        def _hammer_one(qi: int):
+            nonlocal offered, answered, errors, sheds
+            offered += 1
+            try:
+                s, ids2 = svc.topk_vectors(qvs[qi:qi + 1], k=kq)
+            except DeadlineExceeded:
+                sheds += 1
+                offered -= 1          # sheds excluded from availability
+                return
+            except Exception:  # noqa: BLE001 — drill metric, not fatal
+                errors += 1
+                return
+            osc, oid = oracle[qi]
+            if np.array_equal(s, osc) and np.array_equal(ids2, oid):
+                answered += 1
+            else:
+                errors += 1           # wrong bytes are worse than none
+        try:
+            svc.topk_vectors(qvs[:1], k=kq)    # warm over the wire
+            rejoined0 = len(svc.registry.events("worker_rejoined"))
+            t_kill = time.perf_counter()
+            w.kill_connection()
+            recovery = None
+            qi = 0
+            while time.perf_counter() - t_kill < 30.0:
+                _hammer_one(qi % distinct)     # fallback serves the gap
+                qi += 1
+                if (len(svc.registry.events("worker_rejoined")) > rejoined0
+                        and gw.worker_alive(0, 0)):
+                    recovery = time.perf_counter() - t_kill
+                    break
+            rec["chaos_recovery_seconds"] = round(
+                recovery if recovery is not None else 999.0, 3)
+            _faults.install(_faults.FaultPlan.parse(
+                "wire_send:frame_trunc:40,wire_recv:frame_delay:30,"
+                "wire_send:frame_dup:90,wire_send:conn_drop:140", seed=0))
+            n_chaos = int(os.environ.get("BENCH_CHAOS_N", "150"))
+            for i in range(n_chaos):
+                _hammer_one(i % distinct)
+            injected = sum(v for key, v in _faults.counters().items()
+                           if key.startswith("injected_"))
+            rec["chaos_availability"] = round(
+                answered / max(offered, 1), 4)
+            rec["chaos_errors"] = errors
+            _stamp(f"net chaos drill: recovery "
+                   f"{rec['chaos_recovery_seconds']:.3f}s (pin <= "
+                   f"{3 * hb_s:.2f}s), availability "
+                   f"{rec['chaos_availability']:.4f} over {offered} "
+                   f"offered ({injected} faults injected, {errors} "
+                   f"errors, {sheds} sheds)")
+        finally:
+            _faults.reset()
+            w.stop()
+            gw.close()
+            svc.close()
     print(json.dumps(rec), flush=True)
 
 

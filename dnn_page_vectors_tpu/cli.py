@@ -274,6 +274,17 @@ def main(argv=None) -> None:
                     help="fault-injection plan 'op:kind:at[:count],...' "
                          "(utils/faults.py; shorthand for --set "
                          "faults.plan=...). Off by default.")
+    ap.add_argument("--chaos", default=None, metavar="PLAN",
+                    help="loadtest: seeded network-chaos schedule armed "
+                         "under the query hammer (same grammar as "
+                         "--faults, over the wire ops wire_send / "
+                         "wire_recv / worker_dial / gateway_accept / "
+                         "cache_peer_send and kinds conn_drop / "
+                         "frame_delay / frame_trunc / frame_dup). "
+                         "Installed AFTER fleet start so setup never "
+                         "eats the schedule; the report gains a `chaos` "
+                         "block with availability/errors/injected "
+                         "counts (docs/ROBUSTNESS.md).")
     args = ap.parse_args(argv)
 
     if args.command == "configs":
@@ -918,6 +929,12 @@ def main(argv=None) -> None:
             mut = None
         trial_s = (args.trial_s if args.trial_s is not None
                    else cfg.obs.window_s)
+        if args.chaos:
+            # arm the seeded chaos schedule only NOW — store build, fleet
+            # start, and registration must not eat the plan's scheduled
+            # calls (docs/ROBUSTNESS.md "Availability drills")
+            faults.install(faults.FaultPlan.parse(args.chaos,
+                                                  seed=cfg.faults.seed))
         report = find_qps_at_p99(
             svc, wl, queries, p99_target_ms=args.p99_ms,
             start=args.start_qps, iters=args.iters, duration_s=trial_s,
@@ -960,6 +977,27 @@ def main(argv=None) -> None:
                 "partition_degraded": part_met["partition_degraded"],
                 "partitions": part_met["partitions"],
             })
+        if args.chaos:
+            # the availability drill's verdict: fraction of offered
+            # queries ANSWERED (sheds excluded both sides — a shed is
+            # deliberate backpressure, not lost availability)
+            trials = report.get("trials", [])
+            sent = sum(t.get("requests_sent", 0) for t in trials)
+            errs = sum(t.get("errors", 0) for t in trials)
+            sheds = sum(t.get("transport", {}).get("client_sheds", 0)
+                        for t in trials)
+            offered = max(sent - sheds, 1)
+            report["chaos"] = {
+                "plan": args.chaos,
+                "offered": sent,
+                "sheds": sheds,
+                "errors": errs,
+                "availability": round(
+                    max(sent - sheds - errs, 0) / offered, 6),
+                "injected": {key: v for key, v in faults.counters().items()
+                             if key.startswith("injected_")
+                             or key == "worker_reconnect"},
+            }
         if client is not None:
             client.close()
         if net_server is not None:

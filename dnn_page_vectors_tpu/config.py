@@ -318,6 +318,27 @@ class ServeConfig:
     # one hot set. Requires serve.result_cache; mixed fleets where one
     # side never negotiated the flag degrade to local-only caching.
     result_cache_fleet: bool = False
+    # Self-healing fleet (docs/ROBUSTNESS.md "Network failure model").
+    # A partition worker that loses its gateway connection (EOF, torn
+    # frame, socket error) re-dials with exponential backoff + jitter and
+    # re-REGISTERs with its current generation instead of exiting. False
+    # restores the PR-13 behavior: connection loss is terminal.
+    reconnect: bool = True
+    # First re-dial delay (seconds); doubles per consecutive failure.
+    reconnect_base_s: float = 0.05
+    # Backoff cap for the re-dial ramp (seconds) — also the cap for the
+    # wire retry profile around dial+REGISTER (faults.retry_wire).
+    reconnect_max_s: float = 2.0
+    # Gateway-side per-replica circuit breaker: after this many
+    # CONSECUTIVE wire failures the replica's breaker opens
+    # (breaker_open event) and routing skips it — requests go straight
+    # to fallback instead of paying a timeout each. <= 0 disables.
+    breaker_failures: int = 3
+    # How long an open breaker blocks traffic before admitting one
+    # half-open probe (seconds); doubles on every failed probe.
+    breaker_open_s: float = 0.25
+    # Cap for the open-interval ramp (seconds).
+    breaker_max_s: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)

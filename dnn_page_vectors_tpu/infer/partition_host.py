@@ -46,12 +46,24 @@ and its last heartbeat is younger than 2 x `serve.heartbeat_s`.
 Connection EOF / torn frames mark it lost immediately (`worker_lost`
 event) and fail its in-flight RPCs over to the fallback path — recovery
 is bounded by one heartbeat interval even for a silently hung peer.
+
+Self-healing (docs/ROBUSTNESS.md "Network failure model"): a lost
+worker is no longer gone for good — `PartitionWorker.run` is a
+supervised loop that re-dials with exponential backoff + jitter
+(`serve.reconnect_base_s` / `serve.reconnect_max_s`) and re-REGISTERs
+with its current generation; the gateway re-admits it (`worker_rejoined`
+event) and nudges a generation-lagging rejoiner with T_REFRESH so it
+serves nothing stale. Gateway-side, each replica slot carries a
+persistent circuit breaker (`serve.breaker_*`): K consecutive wire
+failures open it and routing skips the replica (straight to local
+fallback, no per-request timeout) until a half-open probe succeeds.
 """
 from __future__ import annotations
 
 import dataclasses
 import json
 import os
+import random
 import socket
 import threading
 import time
@@ -63,6 +75,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from dnn_page_vectors_tpu.infer import transport
+from dnn_page_vectors_tpu.utils import faults
 from dnn_page_vectors_tpu.infer.transport import (
     DeadlineExceeded, FrameError, FLAG_RESULT_CACHE, FLAG_WIRE_COMPRESS,
     FrameSender, InternTable, RemoteError, T_BYE, T_HEARTBEAT, T_HELLO,
@@ -175,6 +188,19 @@ class WorkerGateway:
             serve_cfg is not None
             and getattr(serve_cfg, "result_cache", False)
             and getattr(serve_cfg, "result_cache_fleet", False))
+        # per-replica circuit breakers (docs/ROBUSTNESS.md "Network
+        # failure model"): serve.breaker_failures consecutive wire
+        # failures open a replica's breaker and routing skips it until a
+        # half-open probe succeeds; <= 0 disables breakers entirely
+        self._breaker_failures = int(
+            getattr(serve_cfg, "breaker_failures", 3)
+            if serve_cfg is not None else 3)
+        self._breaker_open_s = float(
+            getattr(serve_cfg, "breaker_open_s", 0.25)
+            if serve_cfg is not None else 0.25)
+        self._breaker_max_s = float(
+            getattr(serve_cfg, "breaker_max_s", 30.0)
+            if serve_cfg is not None else 30.0)
         self.rpc_timeout_s = float(rpc_timeout_s)
         self._own_pset = None
         if pset is None:
@@ -193,6 +219,10 @@ class WorkerGateway:
         # the registry lock, never the reverse (graftcheck lock-order)
         # lock-order: WorkerGateway._lock < _WorkerConn._lock
         self._workers: Dict[Tuple[int, int], _WorkerConn] = {}  # guarded-by: _lock
+        # breakers OUTLIVE their _WorkerConn: keyed by replica slot, so
+        # trip history spans re-registrations (the breaker itself locks
+        # its own state; only the dict is registry state)
+        self._breakers: Dict[Tuple[int, int], faults.CircuitBreaker] = {}  # guarded-by: _lock
         self._pending: Dict[int, Tuple[Future, _WorkerConn]] = {}  # guarded-by: _lock
         self._lat: Dict[int, LatencyStats] = {}   # guarded-by: _lock
         self._registered = 0                      # guarded-by: _lock
@@ -220,6 +250,16 @@ class WorkerGateway:
                 conn, addr = self._sock.accept()
             except OSError:
                 return            # listener closed
+            spec = faults.active().wire("gateway_accept")
+            if spec is not None:
+                # an injected accept fault: the worker's dial lands and
+                # immediately dies (or stalls) — its retry_wire/reconnect
+                # path is what's under test
+                if spec.kind in ("delay", "frame_delay"):
+                    time.sleep(faults.active().wire_delay_s())
+                else:
+                    conn.close()
+                    continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._conn_loop, args=(conn, addr),
                                  daemon=True, name="worker-gateway-reader")
@@ -252,8 +292,16 @@ class WorkerGateway:
                 old = self._workers.get((pid_, rid))
                 self._workers[(pid_, rid)] = worker
                 self._registered += 1
-            if old is not None and old.mark_dead("replaced"):
-                self._fail_inflight(old, "replaced by a new registration")
+            rejoined = False
+            if old is not None:
+                if old.mark_dead("replaced"):
+                    self._fail_inflight(old, "replaced by a new "
+                                             "registration")
+                else:
+                    # the slot's previous connection was already LOST:
+                    # this registration is the self-healing worker's
+                    # re-dial landing (docs/ROBUSTNESS.md)
+                    rejoined = True
             if wflags:
                 # confirm the negotiated capability set on the same
                 # ordered stream — the ack lands before any VQUERY, so
@@ -268,6 +316,28 @@ class WorkerGateway:
                 "wire_compress": bool(agreed & FLAG_WIRE_COMPRESS),
                 "result_cache": bool(agreed & FLAG_RESULT_CACHE),
                 "generation": wgen})
+            if rejoined:
+                # liveness restored: the fresh connection wipes the
+                # breaker's consecutive-failure history (the in-flight
+                # RPCs the loss failed already counted against it)
+                self._breaker_result(pid_, rid, ok=True)
+                svc.registry.event("worker_rejoined", {
+                    "partition": pid_, "replica": rid, "pid": wpid,
+                    "generation": wgen})
+            # a (re)joining worker whose view lags the routed generation
+            # serves NOTHING until REFRESH catches it up (generation
+            # gating in _pick_worker) — nudge it immediately instead of
+            # leaving it stale until the next broadcast_refresh
+            cur_gen = self._routed_generation(pid_)
+            if cur_gen is not None and wgen != cur_gen:
+                try:
+                    with worker.wlock:
+                        worker.sender.send(
+                            T_REFRESH, transport.encode_refresh(cur_gen),
+                            counter=svc._m_wire_bytes,
+                            raw_counter=svc._m_wire_raw)
+                except OSError:
+                    pass          # a dying worker re-registers fresh
             while True:
                 frame = transport.read_frame(conn)
                 if frame is None:
@@ -358,6 +428,66 @@ class WorkerGateway:
         for fut, _ in entries:
             fut.set_exception(RemoteError(f"worker lost: {reason}"))
 
+    def _routed_generation(self, pid: int) -> Optional[int]:
+        """The store generation the front end currently routes for
+        partition `pid` — what a worker must serve to be eligible."""
+        try:
+            views = self.partition_set._view_table[pid]
+        except IndexError:
+            return None
+        return views[0].generation if views else None
+
+    # -- circuit breakers (docs/ROBUSTNESS.md "Network failure model") -----
+    def _breaker(self, pid: int, rid: int) -> faults.CircuitBreaker:
+        """Replica (pid, rid)'s persistent breaker, created on first
+        use. The open/close callbacks run OUTSIDE the breaker's lock
+        (CircuitBreaker contract), so taking the registry lock in
+        _breaker_event keeps the gateway's lock order intact."""
+        with self._lock:
+            br = self._breakers.get((pid, rid))
+            if br is None:
+                br = self._breakers[(pid, rid)] = faults.CircuitBreaker(
+                    failures=self._breaker_failures,
+                    open_s=self._breaker_open_s,
+                    max_open_s=self._breaker_max_s,
+                    on_open=lambda b, p=pid, r=rid: self._breaker_event(
+                        "breaker_open", p, r, b),
+                    on_close=lambda b, p=pid, r=rid: self._breaker_event(
+                        "breaker_close", p, r, b))
+            return br
+
+    def _breaker_allow(self, pid: int, rid: int) -> bool:
+        if self._breaker_failures <= 0:
+            return True
+        return self._breaker(pid, rid).allow()
+
+    def _breaker_result(self, pid: int, rid: int, ok: bool) -> None:
+        """Feed a wire outcome for replica (pid, rid) into its breaker.
+        Only WIRE failures count (send errors, lost workers, remote
+        errors) — a deadline shed is deliberate backpressure from a
+        healthy worker and never opens a breaker."""
+        if self._breaker_failures <= 0:
+            return
+        br = self._breaker(pid, rid)
+        if ok:
+            br.record_success()
+        else:
+            br.record_failure()
+
+    def _breaker_event(self, name: str, pid: int, rid: int,
+                       br: faults.CircuitBreaker) -> None:
+        with self._lock:
+            n_open = sum(1 for b in self._breakers.values()
+                         if b.state == "open")
+        reg = self._svc.registry
+        reg.gauge("serve.breakers_open").set(n_open)
+        attrs = {"partition": pid, "replica": rid,
+                 "trips": br.trips, "open": n_open}
+        if name == "breaker_open":
+            reg.event("breaker_open", attrs)
+        else:
+            reg.event("breaker_close", attrs)
+
     # -- liveness (PartitionSet routing + availability tests) --------------
     def _alive_age_s(self) -> float:
         """Max heartbeat age before a CONNECTED worker counts as hung:
@@ -405,7 +535,10 @@ class WorkerGateway:
         serves a DIFFERENT store generation is ineligible — after a
         refresh the fan-out serves that slice locally (on the already-
         swapped front-end view) until the worker's T_REFRESH ack lands,
-        so one result set can never mix generations across the wire."""
+        so one result set can never mix generations across the wire.
+        A replica whose circuit breaker is open is skipped the same way
+        — the breaker check runs LAST because a half-open breaker's
+        allow() consumes its single probe slot."""
         with self._lock:
             cands = [(rid, w) for (p, rid), w in self._workers.items()
                      if p == pid and rid not in exclude]
@@ -413,7 +546,8 @@ class WorkerGateway:
         age = self._alive_age_s()
         for _, w in cands:
             if w.alive(age) and (generation is None
-                                 or w.generation == generation):
+                                 or w.generation == generation) \
+                    and self._breaker_allow(pid, w.replica):
                 return w
         return None
 
@@ -473,6 +607,8 @@ class WorkerGateway:
                     "partition": worker.partition,
                     "replica": worker.replica,
                     "reason": f"send failed: {e}"[:200]})
+            # no breaker feed here: the RemoteError future is observed
+            # in _await_partition, which records exactly one failure
             fut.set_exception(RemoteError(f"send failed: {e}"))
         return fut
 
@@ -526,7 +662,14 @@ class WorkerGateway:
                                    return_when=FIRST_COMPLETED)
             for fut in done:
                 rid = in_flight.pop(fut)
-                if fut.exception() is None:
+                exc = fut.exception()
+                if exc is not None and isinstance(exc, RemoteError):
+                    # a wire failure (lost worker / failed send / remote
+                    # error) feeds the breaker; a DeadlineExceeded shed
+                    # is deliberate backpressure and never counts
+                    self._breaker_result(pid, rid, ok=False)
+                if exc is None:
+                    self._breaker_result(pid, rid, ok=True)
                     if not hedged:
                         # only UNHEDGED completions feed the hedge-delay
                         # history: a hedged call finishes slow by
@@ -696,12 +839,16 @@ class WorkerGateway:
             compressing = sum(
                 1 for w in self._workers.values()
                 if not w.dead and w.flags & FLAG_WIRE_COMPRESS)
+            breakers = list(self._breakers.values())
         return {
             "workers_live": len(self.live_workers()),
             "workers_registered": registered,
             "workers_compressing": compressing,
             "rpcs": rpcs,
             "rpc_fallbacks": fallbacks,
+            "breakers_open": sum(1 for b in breakers
+                                 if b.state == "open"),
+            "breaker_trips": sum(b.trips for b in breakers),
         }
 
     def close(self) -> None:
@@ -806,39 +953,107 @@ class PartitionWorker:
         self._wlock = threading.Lock()     # serializes frame writes
         self._stop = threading.Event()
         self._sender: Optional[FrameSender] = None  # guarded-by: _wlock
+        # self-healing (docs/ROBUSTNESS.md "Network failure model"): on
+        # connection loss run() re-dials with exponential backoff +
+        # jitter instead of exiting; serve.reconnect=False restores the
+        # connection-loss-is-terminal behavior
+        self.reconnect = bool(getattr(cfg.serve, "reconnect", True))
+        self.reconnect_base_s = float(
+            getattr(cfg.serve, "reconnect_base_s", 0.05))
+        self.reconnect_max_s = float(
+            getattr(cfg.serve, "reconnect_max_s", 2.0))
+        # seeded per-replica jitter: deterministic under test, still
+        # decorrelated across a fleet restarting together
+        self._rng = random.Random(1 + (self.partition << 8) | self.replica)
+        self.sessions = 0   # completed dial+REGISTER rounds (run loop only)
 
     # -- lifecycle ---------------------------------------------------------
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_s):
             try:
                 with self._wlock:
+                    if self._sender is None:
+                        return    # between sessions: this beat's done
                     self._sender.send(T_HEARTBEAT)
             except OSError:
                 return
 
     def run(self) -> None:
-        """Connect, register, serve until the gateway closes the
-        connection (or stop()). Blocking — the process entry point."""
-        sock = socket.create_connection(self.connect)
-        self._sock = sock
+        """Supervised serve loop (docs/ROBUSTNESS.md "Network failure
+        model"): dial + REGISTER + serve; on EOF / torn frame / socket
+        error, re-dial with exponential backoff + jitter (base
+        `serve.reconnect_base_s`, cap `serve.reconnect_max_s`) and
+        re-REGISTER with the CURRENT view generation, so a transient
+        gateway blip costs one reconnect instead of the replica. Exits
+        on a clean T_BYE (deregistered), stop(), or — with
+        serve.reconnect off — the first connection loss. Blocking — the
+        process entry point."""
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                if self._serve_session():
+                    break         # clean T_BYE: deregistered on purpose
+                failures = 0      # a registered session resets the ramp
+            except (FrameError, OSError):
+                failures += 1     # gateway unreachable or stream torn
+            if not self.reconnect or self._stop.is_set():
+                break
+            delay = min(self.reconnect_base_s * (2.0 ** max(failures - 1,
+                                                            0)),
+                        self.reconnect_max_s)
+            delay += self._rng.uniform(0.0, delay / 2.0)
+            faults.count("worker_reconnect")
+            if self._stop.wait(delay):
+                break
+
+    def _dial(self) -> socket.socket:
+        """Dial + REGISTER under the wire retry profile
+        (faults.retry_wire — idempotent: a re-REGISTER replaces the
+        previous registration), advertising the current view
+        generation."""
+        def _connect() -> socket.socket:
+            faults.active().check("worker_dial")
+            sock = socket.create_connection(self.connect)
+            # an OSError on setsockopt or the REGISTER write must close
+            # the socket on its way out (the retry dials fresh), not
+            # leak it (graftcheck lifecycle rule)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                transport.write_frame(
+                    sock, T_REGISTER,
+                    transport.encode_register(
+                        self.partition, self.replica, os.getpid(),
+                        flags=(FLAG_WIRE_COMPRESS
+                               if self.wire_compress else 0)
+                        | (FLAG_RESULT_CACHE
+                           if self.result_cache else 0),
+                        generation=self.view.generation))
+            except OSError:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+            return sock
+        return faults.retry_wire(_connect, op="worker_dial",
+                                 backoff=self.reconnect_base_s,
+                                 max_backoff=self.reconnect_max_s)
+
+    def _serve_session(self) -> bool:
+        """One dial + REGISTER + serve round. -> True on a clean T_BYE,
+        False on EOF at a frame boundary (the supervisor re-dials); torn
+        frames and socket errors propagate to the supervisor's backoff
+        path."""
+        sock = self._dial()
         hb: Optional[threading.Thread] = None
         slots: Dict[int, bytes] = {}   # per-connection intern table
-        # everything past the dial runs inside the try: an OSError on
-        # setsockopt or the REGISTER write must close the socket on its
-        # way out, not leak it (graftcheck lifecycle rule)
+        bye = False
         try:
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+            self.sessions += 1
+            self._flags = 0            # re-negotiated per connection
             with self._wlock:
                 self._sender = FrameSender(sock)
-            transport.write_frame(sock, T_REGISTER,
-                                  transport.encode_register(
-                                      self.partition, self.replica,
-                                      os.getpid(),
-                                      flags=(FLAG_WIRE_COMPRESS
-                                             if self.wire_compress else 0)
-                                      | (FLAG_RESULT_CACHE
-                                         if self.result_cache else 0),
-                                      generation=self.view.generation))
             hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
                                   name=f"worker-p{self.partition}"
                                        f"r{self.replica}-hb")
@@ -857,18 +1072,21 @@ class PartitionWorker:
                 elif ftype == T_REFRESH:
                     self._refresh(transport.decode_refresh(payload))
                 elif ftype == T_BYE:
+                    bye = True
                     break
                 # anything else from the gateway is ignorable control
-        except (FrameError, OSError):
-            pass                  # gateway gone; the process's job is done
         finally:
-            self._stop.set()
-            if hb is not None:
-                hb.join(timeout=2.0)
+            # close FIRST: the heartbeat thread's next send then fails
+            # fast and it exits inside the join window
             try:
                 sock.close()
             except OSError:
                 pass
+            with self._wlock:
+                self._sender = None
+            if hb is not None:
+                hb.join(timeout=self.heartbeat_s + 2.0)
+        return bye
 
     def _refresh(self, generation: int) -> None:
         """The T_REFRESH control path: re-open the store, rebuild this
@@ -971,16 +1189,35 @@ class PartitionWorker:
         with self._wlock:
             self._sender.send(rtype, *parts)
 
+    @staticmethod
+    def _tear(sock: Optional[socket.socket]) -> None:
+        """shutdown + close: a bare close() does not wake the serve
+        loop's blocked recv (the in-flight syscall pins the kernel
+        socket, so no FIN is sent either) — shutdown() tears the stream
+        NOW, exactly like the process dying would."""
+        if sock is None:
+            return
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
     def stop(self) -> None:
-        """Abrupt local shutdown (tests' stand-in for kill -9): close the
+        """Abrupt local shutdown (tests' stand-in for kill -9): tear the
         socket out from under the serve loop."""
         self._stop.set()
-        sock = self._sock
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        self._tear(self._sock)
+
+    def kill_connection(self) -> None:
+        """Drill hook (tests, the bench chaos drill): tear the live
+        connection out from under the serve loop WITHOUT stopping the
+        worker — the supervised run() loop re-dials and re-REGISTERs,
+        which is exactly the recovery path the chaos drills measure."""
+        self._tear(self._sock)
 
 
 def run_partition_worker(cfg, store_dir: str, connect: str, partition: int,

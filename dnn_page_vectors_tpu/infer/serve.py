@@ -505,6 +505,12 @@ class SearchService:
         # handles to sibling front ends sharing the hot set
         # guarded-by: _rcache_lock
         self._rcache_peers: list = []
+        # per-peer circuit breakers, index-aligned with _rcache_peers
+        # (cache_peer breaker scope — docs/ROBUSTNESS.md): a down
+        # sibling is skipped cheaply instead of costing a dial/timeout
+        # on every local miss
+        # guarded-by: _rcache_lock
+        self._rcache_peer_breakers: list = []
         self._m_rcache_hits = reg.counter("serve.result_cache_hits",
                                           window_s=window_s)
         self._m_rcache_misses = reg.counter("serve.result_cache_misses",
@@ -1433,26 +1439,56 @@ class SearchService:
         with result_cache=True) for fleet-wide sharing: a local miss
         probes each peer's cache before computing, and a local fill is
         pushed to every peer fire-and-forget. Peers that never negotiated
-        FLAG_RESULT_CACHE degrade to no-ops per the transport contract."""
+        FLAG_RESULT_CACHE degrade to no-ops per the transport contract.
+
+        Each peer gets its own circuit breaker (`serve.breaker_*` knobs,
+        docs/ROBUSTNESS.md "Network failure model"): after K consecutive
+        probe failures the sibling is skipped outright — a down peer
+        costs one failed dial per open interval, not a dial/timeout on
+        every local miss. `serve.breaker_failures <= 0` disables."""
+        serve_cfg = getattr(self.cfg, "serve", None)
+        k_fail = int(getattr(serve_cfg, "breaker_failures", 3)
+                     if serve_cfg is not None else 3)
+        open_s = float(getattr(serve_cfg, "breaker_open_s", 0.25)
+                       if serve_cfg is not None else 0.25)
+        max_s = float(getattr(serve_cfg, "breaker_max_s", 30.0)
+                      if serve_cfg is not None else 30.0)
         with self._rcache_lock:
             self._rcache_peers = list(clients)
+            self._rcache_peer_breakers = [
+                faults.CircuitBreaker(
+                    failures=k_fail, open_s=open_s, max_open_s=max_s,
+                    on_open=lambda b: faults.count(
+                        "cache_peer_breaker_open"))
+                if k_fail > 0 else None
+                for _ in self._rcache_peers]
+
+    def _peers_with_breakers(self) -> list:
+        with self._rcache_lock:
+            return list(zip(self._rcache_peers,
+                            self._rcache_peer_breakers))
 
     def _peer_lookup(self, key: tuple) -> Optional[list]:
         """Probe attached peers for a miss; a hit is re-formatted against
         the LOCAL store (same corpus fleet-wide, so byte-identical) and
         inserted locally so the next repeat stays in-process."""
-        with self._rcache_lock:
-            peers = list(self._rcache_peers)
+        peers = self._peers_with_breakers()
         if not peers:
             return None
         text, k, nprobe, store_gen, index_gen = key
-        for peer in peers:
+        for peer, br in peers:
+            if br is not None and not br.allow():
+                continue         # breaker open: skip the down sibling
             try:
                 got = peer.cache_lookup(text, k=k, nprobe=nprobe,
                                         store_gen=store_gen,
                                         index_gen=index_gen)
             except Exception:
+                if br is not None:
+                    br.record_failure()
                 continue         # a broken peer never breaks a query
+            if br is not None:
+                br.record_success()
             if got is None:
                 continue
             scores, ids = got
@@ -1464,8 +1500,7 @@ class SearchService:
     def _peer_put(self, key: Optional[tuple], hits: list) -> None:
         if key is None:
             return
-        with self._rcache_lock:
-            peers = list(self._rcache_peers)
+        peers = self._peers_with_breakers()
         if not peers:
             return
         text, k, nprobe, store_gen, index_gen = key
@@ -1474,13 +1509,26 @@ class SearchService:
         for i, h in enumerate(hits[:k]):
             scores[i] = h["score"]
             ids[i] = h["page_id"]
-        for peer in peers:
-            try:
-                peer.cache_put(text, k=k, nprobe=nprobe,
-                               store_gen=store_gen, index_gen=index_gen,
-                               scores=scores, ids=ids)
-            except Exception:
+        for peer, br in peers:
+            if br is not None and not br.allow():
                 continue
+            try:
+                # False = the frame never left (broken connection, or a
+                # peer that never negotiated the flag — skipping that one
+                # is free either way), so the bool feeds the breaker
+                ok = peer.cache_put(text, k=k, nprobe=nprobe,
+                                    store_gen=store_gen,
+                                    index_gen=index_gen,
+                                    scores=scores, ids=ids)
+            except Exception:
+                if br is not None:
+                    br.record_failure()
+                continue
+            if br is not None:
+                if ok:
+                    br.record_success()
+                else:
+                    br.record_failure()
 
     # wire-facing helpers (infer/server.py T_CACHE_LOOKUP / T_CACHE_PUT):
     # operate on the raw [1, k] score/id arrays the RESULT frame ships

@@ -95,10 +95,14 @@ import itertools
 import socket
 import struct
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from dnn_page_vectors_tpu.utils import faults
+from dnn_page_vectors_tpu.utils.faults import InjectedFault
 
 MAGIC = 0x44505631            # "DPV1": protocol id + version in one word
 MAX_FRAME = 64 * 2 ** 20      # reject oversize lengths before any recv
@@ -683,9 +687,74 @@ def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
-def read_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+def _close_quietly(sock: socket.socket) -> None:
+    # shutdown BEFORE close: close() alone does not release the kernel
+    # socket while a peer thread is blocked in recv() on the same fd, so
+    # no FIN reaches either side and the "dropped" connection lingers as
+    # a zombie until the next send; shutdown() wakes blocked readers and
+    # tears the stream immediately — which is what a dropped connection
+    # means
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _wire_fault_send(sock: socket.socket, view, op: str) -> bool:
+    """Consult the active fault plan before a framed send (docs/
+    ROBUSTNESS.md "Network failure model"); ~free with no plan installed.
+    Returns True when the fault itself performed the send (frame_dup);
+    the stream-tearing kinds close the socket and raise InjectedFault so
+    callers' existing OSError recovery paths fire unmodified."""
+    plan = faults.active()
+    spec = plan.wire(op)
+    if spec is None:
+        return False
+    kind = spec.kind
+    if kind in ("delay", "frame_delay"):
+        time.sleep(plan.wire_delay_s())
+        return False                      # stalled; frame still ships
+    if kind == "frame_dup":
+        sock.sendall(view)
+        sock.sendall(view)                # the receiver sees a retransmit
+        return True
+    if kind == "frame_trunc":
+        try:
+            sock.sendall(view[:max(1, len(view) // 2)])
+        except OSError:
+            pass
+        _close_quietly(sock)
+        raise InjectedFault(f"injected fault: {op} frame_trunc")
+    # conn_drop / io_error: the stream dies before any byte of this frame
+    _close_quietly(sock)
+    raise InjectedFault(f"injected fault: {op} {kind}")
+
+
+def _wire_fault_recv(sock: socket.socket, op: str) -> None:
+    """Recv twin of _wire_fault_send, fired as a framed read starts.
+    Delay kinds stall the reader; every other wire kind tears the stream
+    under it (the receiver cannot truncate or duplicate what the peer
+    sends, so frame_trunc/frame_dup degenerate to conn_drop here)."""
+    plan = faults.active()
+    spec = plan.wire(op)
+    if spec is None:
+        return
+    if spec.kind in ("delay", "frame_delay"):
+        time.sleep(plan.wire_delay_s())
+        return
+    _close_quietly(sock)
+    raise InjectedFault(f"injected fault: {op} {spec.kind}")
+
+
+def read_frame(sock: socket.socket,
+               op: str = "wire_recv") -> Optional[Tuple[int, bytes]]:
     """-> (type, payload), or None on clean EOF at a frame boundary.
     Garbage/oversize headers and truncation raise FrameError."""
+    _wire_fault_recv(sock, op)
     hdr = read_exact(sock, HEADER.size)
     if hdr is None:
         return None
@@ -697,11 +766,12 @@ def read_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
 
 
 def write_frame(sock: socket.socket, ftype: int, payload: bytes = b"",
-                counter=None) -> int:
+                counter=None, op: str = "wire_send") -> int:
     """Send one frame; returns the wire bytes written (header included).
     `counter` (a telemetry Counter) accumulates wire-byte accounting."""
     frame = pack_frame(ftype, payload)
-    sock.sendall(frame)
+    if not _wire_fault_send(sock, frame, op):
+        sock.sendall(frame)
     if counter is not None:
         counter.inc(len(frame))
     return len(frame)
@@ -731,11 +801,12 @@ class FrameSender:
         self._buf = bytearray(8192)
 
     def send(self, ftype: int, *parts, counter=None, raw_counter=None,
-             raw_len: Optional[int] = None) -> int:
+             raw_len: Optional[int] = None, op: str = "wire_send") -> int:
         """Assemble + send one frame; returns wire bytes written.
         `raw_len` is the raw-equivalent frame size for compression
         accounting (defaults to the actual size — uncompressed frames
-        count 1:1)."""
+        count 1:1). `op` names the fault-injection point this send fires
+        (docs/ROBUSTNESS.md "Network failure model")."""
         views = [_byte_view(p) for p in parts]
         total = HEADER.size + sum(len(v) for v in views)
         buf = self._buf
@@ -746,7 +817,9 @@ class FrameSender:
         for v in views:
             buf[off: off + len(v)] = v
             off += len(v)
-        self.sock.sendall(memoryview(buf)[:total])
+        frame = memoryview(buf)[:total]
+        if not _wire_fault_send(self.sock, frame, op):
+            self.sock.sendall(frame)
         if counter is not None:
             counter.inc(total)
         if raw_counter is not None:
@@ -789,10 +862,19 @@ class InternTable:
 # framing over asyncio streams (the front-end server)
 # ---------------------------------------------------------------------------
 
-async def read_frame_async(reader: asyncio.StreamReader
+async def read_frame_async(reader: asyncio.StreamReader,
+                           op: str = "wire_recv"
                            ) -> Optional[Tuple[int, bytes]]:
     """Asyncio twin of read_frame: (type, payload), None on clean EOF,
-    FrameError on garbage/oversize/truncation."""
+    FrameError on garbage/oversize/truncation. Injected wire faults
+    surface as FrameError here (no socket handle to drop; the server's
+    torn-frame path closes the connection for us)."""
+    spec = faults.active().wire(op)
+    if spec is not None:
+        if spec.kind in ("delay", "frame_delay"):
+            await asyncio.sleep(faults.active().wire_delay_s())
+        else:
+            raise FrameError(f"injected fault: {op} {spec.kind}")
     try:
         hdr = await reader.readexactly(HEADER.size)
     except asyncio.IncompleteReadError as e:
@@ -896,11 +978,12 @@ class SocketSearchClient:
             self._conns.append(sock)
         return sock, sender, flags, self._local.intern
 
-    def _roundtrip(self, ftype: int, parts: Tuple,
-                   req_id: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    def _roundtrip(self, ftype: int, parts: Tuple, req_id: int,
+                   op: str = "wire_send"
+                   ) -> Tuple[np.ndarray, np.ndarray, int]:
         sock, sender, _, _ = self._conn()
         try:
-            sender.send(ftype, *parts)
+            sender.send(ftype, *parts, op=op)
             frame = read_frame(sock)
         except (OSError, FrameError):
             # a broken connection must not poison the thread's next call
@@ -1007,7 +1090,7 @@ class SocketSearchClient:
                                       index_gen=index_gen)
         try:
             scores, ids, _ = self._roundtrip(T_CACHE_LOOKUP, (payload,),
-                                             req_id)
+                                             req_id, op="cache_peer_send")
         except DeadlineExceeded:
             return None           # SHED_CACHE_MISS: a miss, not a shed
         return scores, ids
@@ -1030,7 +1113,7 @@ class SocketSearchClient:
                                    index_gen=index_gen, scores=scores,
                                    ids=ids)
         try:
-            sender.send(T_CACHE_PUT, payload)
+            sender.send(T_CACHE_PUT, payload, op="cache_peer_send")
         except OSError:
             self._drop_local()
             return False

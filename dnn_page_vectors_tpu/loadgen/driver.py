@@ -229,7 +229,8 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         # snapshot; topology keys (workers_live) report the end state.
         blk: Dict = {}
         for key in ("wire_bytes", "wire_raw_bytes", "deadline_sheds",
-                    "hedge_fires", "rpcs", "rpc_fallbacks"):
+                    "hedge_fires", "rpcs", "rpc_fallbacks",
+                    "breaker_trips"):
             new = (transport1 or {}).get(key)
             if new is not None:
                 blk[key] = new - transport0.get(key, 0)
@@ -237,7 +238,7 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
             blk["wire_compression_ratio"] = round(
                 blk["wire_raw_bytes"] / blk["wire_bytes"], 3)
         for key in ("workers_live", "workers_registered",
-                    "workers_compressing"):
+                    "workers_compressing", "breakers_open"):
             if transport1 and key in transport1:
                 blk[key] = transport1[key]
         if sheds:

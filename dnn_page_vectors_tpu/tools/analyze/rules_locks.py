@@ -57,6 +57,7 @@ class LockDisciplineRule(Rule):
              f"{PKG_NAME}/infer/server.py",
              f"{PKG_NAME}/infer/partition_host.py",
              f"{PKG_NAME}/utils/telemetry.py",
+             f"{PKG_NAME}/utils/faults.py",   # CircuitBreaker state
              f"{PKG_NAME}/updates/append.py", f"{PKG_NAME}/maintenance/")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:

@@ -57,13 +57,25 @@ Injection points (op names):
   lease_dump     append-lease file write (check; inside retry)
   lease_file     the lease tmp file before rename (corrupt)
 
-Plan syntax (config `faults.plan` / CLI `--faults`):
+Wire injection points (docs/ROBUSTNESS.md "Network failure model") — the
+serve fleet's DPV1 frame paths call `active().wire(op)` and act on the
+returned spec themselves (only the call site holds the socket):
+  wire_send        every framed send (FrameSender.send / write_frame)
+  wire_recv        every framed read (read_frame / read_frame_async)
+  worker_dial      PartitionWorker dial+REGISTER (check + wire; inside
+                   retry_wire)
+  gateway_accept   WorkerGateway accept loop, per accepted connection
+  cache_peer_send  result-cache peer probes (CACHE_LOOKUP / CACHE_PUT)
+
+Plan syntax (config `faults.plan` / CLI `--faults` / `--chaos`):
   "op:kind:at[:count]" joined by commas; `at` is the 0-based index of the
   matching call that first faults, `count` how many consecutive calls fault
-  (default 1 = transient; `*` = persistent). Kinds: io_error, truncate,
-  bit_flip, delay. Example — second shard write fails once, the shard-2
-  data file is truncated on disk, the latest checkpoint is torn:
-  "shard_write:io_error:1,shard_file:truncate:2,ckpt_file:truncate:2"
+  (default 1 = transient; `*` = persistent). Filesystem kinds: io_error,
+  truncate, bit_flip, delay. Wire kinds: conn_drop (close the socket
+  mid-stream), frame_delay (seeded stall before a send), frame_trunc (send
+  a prefix then close), frame_dup (re-send the frame twice). Example —
+  second shard write fails once, the third framed send is torn:
+  "shard_write:io_error:1,wire_send:frame_trunc:2"
 """
 from __future__ import annotations
 
@@ -75,7 +87,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-KINDS = ("io_error", "truncate", "bit_flip", "delay")
+WIRE_KINDS = ("conn_drop", "frame_delay", "frame_trunc", "frame_dup")
+KINDS = ("io_error", "truncate", "bit_flip", "delay") + WIRE_KINDS
 PERSISTENT = 1_000_000          # `count` spelling of "every call from `at`"
 
 
@@ -166,6 +179,28 @@ class FaultPlan:
             return
         raise InjectedFault(f"injected fault: {op} "
                             f"(call {self._calls[op] - 1}, spec {spec})")
+
+    def wire(self, op: str) -> Optional[FaultSpec]:
+        """Call once per framed wire operation (`wire_send`, `wire_recv`,
+        `gateway_accept`, ...): advances op's call counter and returns the
+        spec scheduled to fault THIS call, if any. Unlike check(), the
+        ACTION is the caller's job — only the transport call site holds the
+        socket and the frame bytes needed to drop/truncate/duplicate, so
+        this method just decides and accounts. io_error and delay specs on
+        a wire op fire here too (an io_error behaves like conn_drop at call
+        sites without a live socket, e.g. worker_dial)."""
+        if not self._specs:
+            return None
+        spec = self._fire(op, ("io_error", "delay") + WIRE_KINDS)
+        if spec is None:
+            return None
+        count(f"injected_{op}_{spec.kind}")
+        return spec
+
+    def wire_delay_s(self) -> float:
+        """Seeded stall length for a frame_delay / delay wire spec."""
+        with self._lock:
+            return 0.01 + 0.04 * self._rng.random()
 
     def corrupt(self, op: str, path: str) -> bool:
         """Call after a file is durably on disk: applies a scheduled
@@ -298,13 +333,14 @@ def configure_retry(attempts: int, backoff: float, jitter: float) -> None:
 
 def retry(fn, op: str = "io", max_attempts: Optional[int] = None,
           backoff: Optional[float] = None, jitter: Optional[float] = None,
-          retry_on: tuple = (OSError,), profiler=None):
+          retry_on: tuple = (OSError,), profiler=None,
+          max_backoff: Optional[float] = None):
     """Run fn(); on a transient `retry_on` failure, back off (exponential +
-    uniform jitter) and re-run, up to `max_attempts` total attempts. The
-    final failure re-raises the ORIGINAL exception — callers' except
-    clauses and the resume bookkeeping see the same surface as without
-    retry. Backoff sleep lands in `profiler` as stage `io_retry` when one
-    is passed."""
+    uniform jitter, capped at `max_backoff` when given) and re-run, up to
+    `max_attempts` total attempts. The final failure re-raises the ORIGINAL
+    exception — callers' except clauses and the resume bookkeeping see the
+    same surface as without retry. Backoff sleep lands in `profiler` as
+    stage `io_retry` when one is passed."""
     attempts = _RETRY["attempts"] if max_attempts is None else max_attempts
     base = _RETRY["backoff"] if backoff is None else backoff
     jit = _RETRY["jitter"] if jitter is None else jitter
@@ -316,9 +352,129 @@ def retry(fn, op: str = "io", max_attempts: Optional[int] = None,
                 raise
             count(f"retry_{op}")
             delay = base * (2 ** attempt) + random.uniform(0.0, jit)
+            if max_backoff is not None:
+                delay = min(delay, max_backoff)
             warn(f"transient {op} failure ({type(e).__name__}: {e}); "
                  f"retry {attempt + 1}/{attempts - 1} in {delay:.3f}s")
             t0 = time.perf_counter()
             time.sleep(delay)
             if profiler is not None:
                 profiler.add("io_retry", time.perf_counter() - t0)
+
+
+def retry_wire(fn, op: str = "wire", attempts: Optional[int] = None,
+               backoff: Optional[float] = None,
+               max_backoff: Optional[float] = None):
+    """The WIRE retry profile (docs/ROBUSTNESS.md "Network failure model").
+
+    `retry()`'s defaults are filesystem-tuned (short backoff, no cap —
+    disks come back fast or not at all); a dialing worker instead wants a
+    bounded exponential ramp so a restarting gateway is not hammered.
+    Call-site discipline: only wrap IDEMPOTENT operations — dial, REGISTER
+    (re-registration replaces the previous connection), CACHE_LOOKUP.
+    Never wrap a CACHE_PUT: a duplicate put after an ambiguous failure can
+    resurrect an entry a concurrent refresh just invalidated, so puts stay
+    fire-and-forget (SocketSearchClient.cache_put drops on OSError).
+
+    attempts/backoff default from the module retry policy; `max_backoff`
+    should carry the caller's `serve.reconnect_max_s` cap."""
+    return retry(fn, op=op, max_attempts=attempts, backoff=backoff,
+                 retry_on=(OSError,), max_backoff=max_backoff)
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-target wire circuit breaker (docs/ROBUSTNESS.md "Network
+    failure model"). CLOSED: traffic flows, consecutive failures are
+    counted. After `failures` consecutive failures the breaker OPENS:
+    `allow()` answers False so the caller routes straight to its fallback
+    without paying a dial/timeout per request. After `open_s` it admits
+    exactly ONE half-open probe; a success closes the breaker, a failure
+    re-opens it with the backoff doubled (capped at `max_open_s`).
+
+    `clock` is injectable for fake-clock tests. The optional `on_open` /
+    `on_close` callbacks fire on state transitions OUTSIDE the lock (they
+    typically emit registry events; holding `_lock` across them would
+    pin a lock order against the caller's own locks)."""
+
+    def __init__(self, failures: int = 3, open_s: float = 0.25,
+                 max_open_s: float = 30.0, clock=time.monotonic,
+                 on_open=None, on_close=None):
+        self._threshold = max(1, int(failures))
+        self._base_open_s = float(open_s)
+        self._max_open_s = float(max_open_s)
+        self._clock = clock
+        self._on_open = on_open
+        self._on_close = on_close
+        self._lock = threading.Lock()
+        self._state = "closed"            # guarded-by: _lock
+        self._failures = 0                # guarded-by: _lock (consecutive)
+        self._open_s = float(open_s)      # guarded-by: _lock (current ramp)
+        self._opened_at = 0.0             # guarded-by: _lock
+        self._trips = 0                   # guarded-by: _lock
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def trips(self) -> int:
+        with self._lock:
+            return self._trips
+
+    def allow(self) -> bool:
+        """May traffic be sent to this target right now? Open → False
+        until the backoff elapses, then flips to half-open and admits the
+        caller as THE single probe (further calls answer False until the
+        probe reports back). Call it last in a routing decision — a True
+        answer from a half-open breaker consumes the probe slot."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if self._clock() - self._opened_at < self._open_s:
+                    return False
+                self._state = "half_open"
+                return True
+            return False                  # half_open: probe already out
+
+    def record_success(self) -> None:
+        """A request to the target completed: close + reset the ramp."""
+        cb = None
+        with self._lock:
+            if self._state != "closed":
+                cb = self._on_close
+            self._state = "closed"
+            self._failures = 0
+            self._open_s = self._base_open_s
+        if cb is not None:
+            cb(self)
+
+    def record_failure(self) -> None:
+        """A request to the target failed at the wire. The K-th
+        consecutive failure opens the breaker; a failed half-open probe
+        re-opens it with the backoff doubled."""
+        cb = None
+        with self._lock:
+            self._failures += 1
+            if self._state == "half_open":
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._open_s = min(self._open_s * 2.0, self._max_open_s)
+                self._trips += 1
+                cb = self._on_open
+            elif self._state == "closed" and self._failures >= self._threshold:
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._trips += 1
+                cb = self._on_open
+        if cb is not None:
+            cb(self)
+
+    def reset(self) -> None:
+        """Forget history (a worker re-registered: liveness is restored,
+        the fresh connection deserves a clean slate)."""
+        self.record_success()
