@@ -247,10 +247,26 @@ def main(argv=None) -> None:
                          "points the driver's issue path at the socket "
                          "client, so qps@p99 covers the full network path "
                          "(docs/SERVING.md 'Network front end')")
+    ap.add_argument("--front-ends", dest="front_ends", type=int, default=1,
+                    metavar="N",
+                    help="loadtest: run N socket front ends over ONE "
+                         "shared worker fleet (docs/SCALING.md 'Scale-out "
+                         "tier') — each gets its own WorkerGateway and "
+                         "listener, every worker registers with all N, "
+                         "and the driver spreads load across them with a "
+                         "seeded client-side balancer; the report gains a "
+                         "per-front-end qps/p99 block. Requires "
+                         "--transport socket when N > 1")
+    ap.add_argument("--balance", default="round_robin",
+                    choices=["round_robin", "least_loaded"],
+                    help="loadtest: client-side balancing policy across "
+                         "--front-ends (seeded by --seed so runs replay)")
     # -- partition-worker (docs/SERVING.md "Network front end") ------------
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="partition-worker: the front end's WorkerGateway "
-                         "address to register with")
+                         "address to register with — comma-separated "
+                         "HOST:PORT,... registers this worker with EVERY "
+                         "listed gateway (multi-front-end tier)")
     ap.add_argument("--partition", type=int, default=0, metavar="I",
                     help="partition-worker: which partition of the "
                          "--partitions-way balanced split this process "
@@ -832,8 +848,16 @@ def main(argv=None) -> None:
         k = args.topk or cfg.eval.recall_k
         svc.warmup(k=k)
         svc.start_batcher()
+        n_fe = max(1, int(args.front_ends))
+        if n_fe > 1 and args.transport != "socket":
+            raise SystemExit("--front-ends N > 1 requires --transport "
+                             "socket (the balancer spreads load across N "
+                             "listeners; an in-process service has none)")
         client = None
-        net_server = gateway = None
+        fe_svcs = [svc]
+        net_servers = []
+        gateways = []
+        clients = []
         worker_procs = []
         if args.transport == "socket":
             # the over-the-wire path (docs/SERVING.md "Network front
@@ -850,16 +874,31 @@ def main(argv=None) -> None:
                 serve_in_background)
             from dnn_page_vectors_tpu.infer.transport import (
                 SocketSearchClient)
+            from dnn_page_vectors_tpu.loadgen import BalancedClient
+            for _fe in range(1, n_fe):
+                # extra front ends (docs/SCALING.md "Scale-out tier"):
+                # each is a full SearchService over the SAME store with
+                # its own gateway + listener; the shared worker fleet
+                # below registers with every one of them
+                fe = SearchService(cfg, embedder, trainer.corpus, store,
+                                   preload_hbm_gb=4.0)
+                fe.warmup(k=k)
+                fe.start_batcher()
+                fe_svcs.append(fe)
             if svc.partition_set is not None:
-                gateway = WorkerGateway(svc)
-                svc.attach_gateway(gateway)
+                for fe in fe_svcs:
+                    gw = WorkerGateway(fe)
+                    fe.attach_gateway(gw)
+                    gateways.append(gw)
                 P = svc.partition_set.partitions
                 R = svc.partition_set.replicas
+                connect = ",".join(f"{gw.host}:{gw.port}"
+                                   for gw in gateways)
                 base_cmd = [_sys.executable, "-m",
                             "dnn_page_vectors_tpu.cli", "partition-worker",
                             "--config", args.config,
                             "--workdir", cfg.workdir,
-                            "--connect", f"{gateway.host}:{gateway.port}",
+                            "--connect", connect,
                             "--partitions", str(P)]
                 for pair in args.overrides or []:
                     base_cmd += ["--set", pair]
@@ -879,20 +918,30 @@ def main(argv=None) -> None:
                                         "--replica", str(wr)],
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL))
-                if not gateway.wait_for_workers(P * R, timeout_s=120.0):
-                    print(json.dumps({
-                        "warning": "not every partition worker registered"
-                                   " in time; unserved partitions fall "
-                                   "back to local views",
-                        "workers_live": len(gateway.live_workers()),
-                        "expected": P * R}), file=sys.stderr, flush=True)
-            net_server = serve_in_background(svc)
-            client = SocketSearchClient(
-                net_server.host, net_server.port,
-                deadline_ms=cfg.serve.deadline_ms,
-                compress=cfg.serve.wire_compress,
-                result_cache=bool(cfg.serve.result_cache
-                                  and cfg.serve.result_cache_fleet))
+                for fe_i, gw in enumerate(gateways):
+                    if not gw.wait_for_workers(P * R, timeout_s=120.0):
+                        print(json.dumps({
+                            "warning": "not every partition worker "
+                                       "registered in time; unserved "
+                                       "partitions fall back to local "
+                                       "views",
+                            "front_end": fe_i,
+                            "workers_live": len(gw.live_workers()),
+                            "expected": P * R}), file=sys.stderr,
+                            flush=True)
+            for fe_i, fe in enumerate(fe_svcs):
+                net_servers.append(serve_in_background(fe,
+                                                       front_end=fe_i))
+            for ns in net_servers:
+                clients.append(SocketSearchClient(
+                    ns.host, ns.port,
+                    deadline_ms=cfg.serve.deadline_ms,
+                    compress=cfg.serve.wire_compress,
+                    result_cache=bool(cfg.serve.result_cache
+                                      and cfg.serve.result_cache_fleet)))
+            client = (clients[0] if n_fe == 1 else
+                      BalancedClient(clients, policy=args.balance,
+                                     seed=args.seed))
         distinct = max(1, args.distinct)
         queries = [trainer.corpus.query_text(i) for i in range(distinct)]
         wl = make_workload(args.shape, seed=args.seed, distinct=distinct,
@@ -940,15 +989,20 @@ def main(argv=None) -> None:
             start=args.start_qps, iters=args.iters, duration_s=trial_s,
             warmup_s=args.warmup_s, mutator=mut, client=client,
             progress=lambda line: print(line, file=sys.stderr, flush=True),
-            progress_every_s=max(1.0, trial_s / 2.0))
+            progress_every_s=max(1.0, trial_s / 2.0),
+            front_ends=fe_svcs if n_fe > 1 else None)
         if args.transport == "socket":
             final_met = svc.metrics()
             report.update({
                 "transport": "socket",
-                "listen": f"{net_server.host}:{net_server.port}",
+                "listen": ",".join(f"{ns.host}:{ns.port}"
+                                   for ns in net_servers),
                 **({"transport_totals": final_met["transport"]}
                    if "transport" in final_met else {}),
             })
+            if n_fe > 1:
+                report["front_ends"] = n_fe
+                report["balance_policy"] = args.balance
         if cfg.serve.result_cache:
             # result-cache block (docs/SERVING.md "Result cache"): run
             # totals straight off the registry — per-trial deltas ride
@@ -998,10 +1052,10 @@ def main(argv=None) -> None:
                              if key.startswith("injected_")
                              or key == "worker_reconnect"},
             }
-        if client is not None:
-            client.close()
-        if net_server is not None:
-            net_server.close()
+        for c in clients:
+            c.close()
+        for ns in net_servers:
+            ns.close()
         for proc in worker_procs:
             proc.terminate()
         for proc in worker_procs:
@@ -1009,8 +1063,10 @@ def main(argv=None) -> None:
                 proc.wait(timeout=10)
             except Exception:  # noqa: BLE001 — a stuck worker gets killed
                 proc.kill()
-        if gateway is not None:
-            gateway.close()
+        for gw in gateways:
+            gw.close()
+        for fe in fe_svcs[1:]:
+            fe.close()
         svc.close()
         report.update({
             "store_vectors": store.num_vectors,

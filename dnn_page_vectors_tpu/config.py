@@ -339,6 +339,14 @@ class ServeConfig:
     breaker_open_s: float = 0.25
     # Cap for the open-interval ramp (seconds).
     breaker_max_s: float = 30.0
+    # Elastic fleet membership (docs/SCALING.md "Scale-out tier"): the
+    # gateway re-cuts the partition split to match the live worker set —
+    # a worker joining at the next tail index widens it, a draining tail
+    # worker shrinks it — via a deterministic partition_shard_ranges
+    # re-split and the generation-gated REFRESH handoff (fleet_resplit
+    # event), with no restarts and no result set ever mixing splits.
+    # Off (the default): the split is fixed at boot, exactly as before.
+    elastic: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -396,6 +404,29 @@ class MaintenanceConfig:
     # generation beside the live one, hot-swapping via refresh(). False
     # keeps the PR-5 inline-rebuild behavior even with maintenance running.
     bg_rebuild: bool = True
+    # Autoscale pillar (docs/SCALING.md "Scale-out tier"): drive worker
+    # spawn/drain decisions from the serving telemetry — scale UP when
+    # the windowed queue-wait p99 or the deadline-shed rate crosses its
+    # up-threshold, DOWN when queue wait sits below the down-threshold
+    # with zero sheds. Decisions only fire through hooks the operator
+    # attaches (MaintenanceService.attach_scaler); without hooks the
+    # pillar still evaluates and emits autoscale_up/autoscale_down
+    # events, so the policy is observable before it is trusted. Off by
+    # default.
+    autoscale: bool = False
+    # Fleet-size floor/ceiling the policy may move between.
+    autoscale_min_workers: int = 1
+    autoscale_max_workers: int = 4
+    # Scale-up triggers: windowed queue-wait p99 (ms) or deadline-shed
+    # rate (sheds/s over the telemetry window) at/above these.
+    autoscale_up_queue_p99_ms: float = 50.0
+    autoscale_up_shed_rate: float = 0.5
+    # Scale-down trigger: queue-wait p99 at/below this with a zero shed
+    # rate (and at least one full cooldown of calm).
+    autoscale_down_queue_p99_ms: float = 5.0
+    # Minimum seconds between scaling actions — a resize's own dip must
+    # not read as new pressure before the fleet settles.
+    autoscale_cooldown_s: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)

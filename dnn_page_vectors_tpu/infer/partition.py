@@ -231,6 +231,10 @@ class PartitionSet:
                                      hot_gb=svc._hot_gb)
         self.partitions = len(specs)
         self.replicas = max(1, int(replicas))
+        # the replica grid only ever GROWS (resize never removes rows):
+        # an in-flight scatter that captured a wider table keeps routing
+        # into the tail rows until it finishes — rows beyond
+        # self.partitions are simply never routed by new scatters
         self._parts: List[List[_PartitionReplica]] = []
         table: List[tuple] = []
         for spec in specs:
@@ -375,11 +379,15 @@ class PartitionSet:
         qv = np.asarray(qv, np.float32)
         # ONE table snapshot for the whole scatter: every partition
         # answers from the same published generation set, so a refresh
-        # landing mid-scatter cannot mix generations across partitions
+        # landing mid-scatter cannot mix generations across partitions.
+        # The scatter WIDTH also derives from the snapshot (not from
+        # self.partitions): an elastic resize() publishing mid-scatter
+        # can therefore never mix partition splits inside one result set
+        # — the PR-14 no-mixed-generations pin, extended to splits
         table = self._view_table
-        with svc._stage("scatter", partitions=self.partitions):
+        with svc._stage("scatter", partitions=len(table)):
             futs = []
-            for pid in range(self.partitions):
+            for pid in range(len(table)):
                 rep = self._route(pid)
                 view = table[pid][rep.rid]
                 futs.append(rep.submit(
@@ -401,7 +409,7 @@ class PartitionSet:
         qv = np.asarray(qv, np.float32)
         table = self._view_table
         parts, times, scans = [], [], []
-        for pid in range(self.partitions):
+        for pid in range(len(table)):
             rep = self._route(pid)
             view = table[pid][rep.rid]
             (res, dt) = rep.run_inline(
@@ -476,6 +484,59 @@ class PartitionSet:
                 rep.spec = specs[pid]
         return out
 
+    # -- elastic re-split (docs/SCALING.md "Scale-out tier") ---------------
+    def resize(self, new_store, partitions: int) -> List[Dict]:
+        """Re-split the store over a NEW partition width (elastic fleet
+        membership: a worker joined or drained). Same build-beside-then-
+        publish discipline as refresh(): every partition's view over its
+        new contiguous slice builds beside the serving table, then the
+        finished table — at the new width — publishes with ONE reference
+        assignment. A scatter snapshots the table once and derives its
+        width from the snapshot, so no result set ever mixes splits.
+        Rows the shrink strands (pid >= new width) stay in the replica
+        grid for scatters in flight but are never routed again. Returns
+        the per-partition restage record (refresh()'s shape)."""
+        svc = self._svc
+        specs = make_partition_specs(new_store.shards(),
+                                     max(1, int(partitions)),
+                                     hot_gb=svc._hot_gb)
+        width = len(specs)       # clamped to the shard count
+        while len(self._parts) < width:
+            pid = len(self._parts)
+            reps = [_PartitionReplica(specs[pid], rid)
+                    for rid in range(self.replicas)]
+            self._parts.append(reps)
+            with self._route_lock:
+                self._sheds.append(0)
+                self._degraded_serves.append(0)
+        out: List[Dict] = []
+        new_table: List[tuple] = []
+        for pid in range(width):
+            spec = specs[pid]
+            swaps, row = [], []
+            for rep in self._parts[pid]:
+                t0 = time.perf_counter()
+                rep.set_restaging(True)
+                try:
+                    row.append(svc._build_view(
+                        new_store, reuse=rep.view,
+                        entries=list(spec.entries), hot_gb=spec.hot_gb))
+                finally:
+                    rep.set_restaging(False)
+                swaps.append(round((time.perf_counter() - t0) * 1000.0, 3))
+            new_table.append(tuple(row))
+            out.append({"partition": pid,
+                        "shards": list(spec.shard_indices),
+                        "rows": spec.rows,
+                        "restage_ms": swaps})
+        self._view_table = tuple(new_table)  # THE swap: one assignment
+        self.partitions = width
+        for pid in range(width):
+            for rep, view in zip(self._parts[pid], new_table[pid]):
+                rep.view = view
+                rep.spec = specs[pid]
+        return out
+
     # -- telemetry ---------------------------------------------------------
     def stats(self) -> List[Dict]:
         """Per-partition topology + routing health: the metrics() /
@@ -485,7 +546,9 @@ class PartitionSet:
             sheds = list(self._sheds)
             degr = list(self._degraded_serves)
         out = []
-        for pid, reps in enumerate(self._parts):
+        # bounded by the LIVE width: rows a shrink stranded are not part
+        # of the serving topology any more
+        for pid, reps in enumerate(self._parts[:self.partitions]):
             rstats = [r.stats() for r in reps]
             out.append({
                 "partition": pid,

@@ -78,8 +78,8 @@ from dnn_page_vectors_tpu.infer import transport
 from dnn_page_vectors_tpu.utils import faults
 from dnn_page_vectors_tpu.infer.transport import (
     DeadlineExceeded, FrameError, FLAG_RESULT_CACHE, FLAG_WIRE_COMPRESS,
-    FrameSender, InternTable, RemoteError, T_BYE, T_HEARTBEAT, T_HELLO,
-    T_REFRESH, T_REGISTER, T_RESULT, T_RESULT_C, T_SHED, T_ERROR,
+    FrameSender, InternTable, RemoteError, T_BYE, T_DRAIN, T_HEARTBEAT,
+    T_HELLO, T_REFRESH, T_REGISTER, T_RESULT, T_RESULT_C, T_SHED, T_ERROR,
     T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF)
 from dnn_page_vectors_tpu.ops.topk import merge_partition_topk
 from dnn_page_vectors_tpu.utils.profiling import LatencyStats
@@ -118,6 +118,17 @@ class _WorkerConn:
         self._dead = False                       # guarded-by: _lock
         self._lost_reason: Optional[str] = None  # guarded-by: _lock
         self._generation = int(generation)       # guarded-by: _lock
+        # the partition-split width this worker's REFRESH ack says its
+        # view was built over; None until the first ack lands (a
+        # pre-elastic worker never reports one). Elastic routing gates
+        # on it exactly like it gates on generation — a worker on the
+        # wrong split serves NOTHING until it re-splits, so one result
+        # set can never mix splits across the wire.
+        self._split: Optional[int] = None        # guarded-by: _lock
+        # a draining worker announced T_DRAIN: routing stops sending it
+        # new work (its slice falls back to the local view) and the
+        # elastic fleet width no longer counts it
+        self._draining = False                   # guarded-by: _lock
 
     def beat(self) -> None:
         with self._lock:
@@ -149,9 +160,31 @@ class _WorkerConn:
         with self._lock:
             return self._generation
 
-    def set_generation(self, gen: int) -> None:
+    def set_generation(self, gen: int,
+                       split: Optional[int] = None) -> None:
         with self._lock:
             self._generation = int(gen)
+            if split is not None and split > 0:
+                self._split = int(split)
+
+    @property
+    def split(self) -> Optional[int]:
+        with self._lock:
+            return self._split
+
+    def set_draining(self) -> bool:
+        """-> True exactly once (the transitioning caller emits the
+        worker_draining event and triggers the elastic shrink)."""
+        with self._lock:
+            if self._draining:
+                return False
+            self._draining = True
+            return True
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
 
 
 class WorkerGateway:
@@ -201,6 +234,15 @@ class WorkerGateway:
         self._breaker_max_s = float(
             getattr(serve_cfg, "breaker_max_s", 30.0)
             if serve_cfg is not None else 30.0)
+        # serve.elastic (docs/SCALING.md "Scale-out tier"): fleet
+        # membership drives the partition split. A worker joining at the
+        # next tail index widens the split (deterministic
+        # partition_shard_ranges re-cut), a draining tail worker shrinks
+        # it — both through the same generation-gated REFRESH handoff a
+        # store swap uses, so no result set ever mixes splits. Off (the
+        # default), the split is fixed at boot exactly as before.
+        self._elastic = bool(getattr(serve_cfg, "elastic", False)
+                             if serve_cfg is not None else False)
         self.rpc_timeout_s = float(rpc_timeout_s)
         self._own_pset = None
         if pset is None:
@@ -228,8 +270,20 @@ class WorkerGateway:
         self._registered = 0                      # guarded-by: _lock
         self._rpcs = 0                            # guarded-by: _lock
         self._rpc_fallbacks = 0                   # guarded-by: _lock
+        self._resplits = 0                        # guarded-by: _lock
+        self._wait_timeouts = 0                   # guarded-by: _lock
         self._closed = False                      # guarded-by: _lock
         self._threads: List[threading.Thread] = []   # guarded-by: _lock
+        # serializes elastic re-splits (a join and a drain landing
+        # together must re-cut once, not interleave two resizes). Held
+        # OUTSIDE the registry lock and the service's refresh lock: the
+        # membership snapshot is taken under _lock and released before
+        # the resize starts, and the resize itself runs under the same
+        # svc._refresh_lock a store refresh uses, so a refresh and a
+        # re-split can never interleave their view swaps.
+        # lock-order: WorkerGateway._resplit_lock < SearchService._refresh_lock
+        # lock-order: SearchService._refresh_lock < WorkerGateway._lock
+        self._resplit_lock = threading.Lock()
         # the listener socket and the accept-thread handle are OWNER
         # state: bound here, closed/joined only by close() — reader
         # threads never touch them
@@ -327,17 +381,29 @@ class WorkerGateway:
             # a (re)joining worker whose view lags the routed generation
             # serves NOTHING until REFRESH catches it up (generation
             # gating in _pick_worker) — nudge it immediately instead of
-            # leaving it stale until the next broadcast_refresh
+            # leaving it stale until the next broadcast_refresh. In
+            # elastic mode the nudge ALWAYS fires and carries the routed
+            # split width too: a joiner's split is unknown until its ack
+            # lands (split gating), so the nudge is also how it becomes
+            # routable at all.
             cur_gen = self._routed_generation(pid_)
-            if cur_gen is not None and wgen != cur_gen:
+            cur_split = (len(self.partition_set._view_table)
+                         if self._elastic else 0)
+            if cur_gen is not None and (wgen != cur_gen or self._elastic):
                 try:
                     with worker.wlock:
                         worker.sender.send(
-                            T_REFRESH, transport.encode_refresh(cur_gen),
+                            T_REFRESH,
+                            transport.encode_refresh(cur_gen, cur_split),
                             counter=svc._m_wire_bytes,
                             raw_counter=svc._m_wire_raw)
                 except OSError:
                     pass          # a dying worker re-registers fresh
+            # a join at the next tail index widens the elastic fleet:
+            # re-cut the split over the new width and broadcast the
+            # handoff (no-op unless serve.elastic and the live set is
+            # contiguous at a new width)
+            self._maybe_resplit(trigger="join")
             while True:
                 frame = transport.read_frame(conn)
                 if frame is None:
@@ -352,14 +418,27 @@ class WorkerGateway:
                     self._resolve(ftype, payload, actual)
                 elif ftype == T_REFRESH:
                     # the worker's view-rebuild ack: it now serves this
-                    # store generation and is routable again
+                    # store generation (and, extended form, this split
+                    # width) and is routable again
                     self._account(actual)
-                    gen = transport.decode_refresh(payload)
-                    worker.set_generation(gen)
+                    gen, wsplit = transport.decode_refresh(payload)
+                    worker.set_generation(gen, split=wsplit)
                     worker.beat()
                     svc.registry.event("worker_refreshed", {
                         "partition": worker.partition,
-                        "replica": worker.replica, "generation": gen})
+                        "replica": worker.replica, "generation": gen,
+                        "partitions": wsplit})
+                elif ftype == T_DRAIN:
+                    # the worker announced a graceful exit: stop routing
+                    # it new work NOW (its slice serves from the local
+                    # view), and let the elastic fleet shrink around it
+                    self._account(actual)
+                    worker.beat()
+                    if worker.set_draining():
+                        svc.registry.event("worker_draining", {
+                            "partition": worker.partition,
+                            "replica": worker.replica, "pid": worker.pid})
+                        self._maybe_resplit(trigger="drain")
                 elif ftype == T_BYE:
                     self._account(actual)
                     reason = "deregistered"
@@ -517,17 +596,38 @@ class WorkerGateway:
 
     def wait_for_workers(self, n: int, timeout_s: float = 30.0) -> bool:
         """Block until `n` workers are live (fleet-start barrier for
-        cli/bench) — False on timeout."""
-        t_end = time.perf_counter() + timeout_s
+        cli/bench) — False on timeout, after recording WHAT the barrier
+        waited for and the fleet state it saw (`gateway_wait_timeout`
+        event + the stats() wait_timeouts counter): a silent False is
+        undebuggable once re-splits make barriers routine."""
+        t0 = time.perf_counter()
+        t_end = t0 + timeout_s
         while time.perf_counter() < t_end:
             if len(self.live_workers()) >= n:
                 return True
             time.sleep(0.01)
-        return len(self.live_workers()) >= n
+        live = len(self.live_workers())
+        if live >= n:
+            return True
+        with self._lock:
+            registered = self._registered
+        self._note_wait_timeout(
+            "workers", time.perf_counter() - t0, timeout_s,
+            wanted=int(n), live=live, registered=registered)
+        return False
+
+    def _note_wait_timeout(self, barrier: str, waited_s: float,
+                           timeout_s: float, **state) -> None:
+        with self._lock:
+            self._wait_timeouts += 1
+        self._svc.registry.event("gateway_wait_timeout", dict(
+            {"barrier": barrier, "waited_s": round(waited_s, 3),
+             "timeout_s": round(float(timeout_s), 3)}, **state))
 
     def _pick_worker(self, pid: int, prefer_rid: int,
                      exclude: Tuple[int, ...] = (),
-                     generation: Optional[int] = None
+                     generation: Optional[int] = None,
+                     split: Optional[int] = None
                      ) -> Optional[_WorkerConn]:
         """The live worker that should answer partition `pid`: the routed
         replica's own worker when live, else the lowest-rid live sibling
@@ -536,17 +636,24 @@ class WorkerGateway:
         refresh the fan-out serves that slice locally (on the already-
         swapped front-end view) until the worker's T_REFRESH ack lands,
         so one result set can never mix generations across the wire.
-        A replica whose circuit breaker is open is skipped the same way
-        — the breaker check runs LAST because a half-open breaker's
-        allow() consumes its single probe slot."""
+        `split` gates identically on the partition-split width the
+        worker last ACKED (elastic mode): a worker cut over a different
+        width — or one that never reported — serves nothing, so one
+        result set can never mix splits either. A draining worker is
+        skipped unconditionally (its slice falls back to the local
+        view). A replica whose circuit breaker is open is skipped the
+        same way — the breaker check runs LAST because a half-open
+        breaker's allow() consumes its single probe slot."""
         with self._lock:
             cands = [(rid, w) for (p, rid), w in self._workers.items()
                      if p == pid and rid not in exclude]
         cands.sort(key=lambda t: (t[0] != prefer_rid, t[0]))
         age = self._alive_age_s()
         for _, w in cands:
-            if w.alive(age) and (generation is None
-                                 or w.generation == generation) \
+            if w.alive(age) and not w.draining \
+                    and (generation is None
+                         or w.generation == generation) \
+                    and (split is None or w.split == split) \
                     and self._breaker_allow(pid, w.replica):
                 return w
         return None
@@ -599,8 +706,11 @@ class WorkerGateway:
                                        counter=svc._m_wire_bytes,
                                        raw_counter=svc._m_wire_raw)
         except OSError as e:
+            # popping the entry claims the right to complete the future:
+            # the reader thread races us here (a torn send closes the
+            # socket, so its _fail_inflight may fail this req_id first)
             with self._lock:
-                self._pending.pop(req_id, None)
+                claimed = self._pending.pop(req_id, None) is not None
             if worker.mark_dead(f"send failed: {e}"):
                 self._fail_inflight(worker, f"send failed: {e}")
                 svc.registry.event("worker_lost", {
@@ -609,7 +719,8 @@ class WorkerGateway:
                     "reason": f"send failed: {e}"[:200]})
             # no breaker feed here: the RemoteError future is observed
             # in _await_partition, which records exactly one failure
-            fut.set_exception(RemoteError(f"send failed: {e}"))
+            if claimed:
+                fut.set_exception(RemoteError(f"send failed: {e}"))
         return fut
 
     def _hedge_delay_s(self, pid: int) -> Optional[float]:
@@ -636,7 +747,8 @@ class WorkerGateway:
                          first_rid: int, prep: Tuple[bytes, int, int],
                          k: int, nprobe: Optional[int],
                          deadline: Optional[float],
-                         generation: Optional[int] = None
+                         generation: Optional[int] = None,
+                         split: Optional[int] = None
                          ) -> Optional[Tuple]:
         """Wait for partition `pid`'s RPC answer, hedging to a sibling at
         the latency-quantile point and failing over on worker loss; None
@@ -690,7 +802,7 @@ class WorkerGateway:
                 # sibling (not a hedge — the first copy is already dead)
                 w = self._pick_worker(pid, prefer_rid,
                                       exclude=tuple(tried),
-                                      generation=generation)
+                                      generation=generation, split=split)
                 if w is None:
                     return None
                 in_flight[self._send(w, prep, k, nprobe, deadline)] = \
@@ -704,7 +816,7 @@ class WorkerGateway:
                 hedged = True
                 w = self._pick_worker(pid, prefer_rid,
                                       exclude=tuple(tried),
-                                      generation=generation)
+                                      generation=generation, split=split)
                 if w is not None:
                     svc._m_hedge_fired.inc()
                     cur = svc.tracer.current()
@@ -729,8 +841,14 @@ class WorkerGateway:
         byte-identical to `PartitionSet.topk` by construction."""
         svc = self._svc
         pset = self.partition_set
+        # ONE table snapshot anchors the whole scatter: its length IS
+        # the split width every per-partition decision below is gated
+        # on, so a concurrent elastic re-split (which publishes a new
+        # table in one assignment) can never hand this result set a
+        # mixed cut — the same snapshot idiom that pins generations
         table = pset._view_table
-        P = pset.partitions
+        P = len(table)
+        split = P if self._elastic else None
         # ONE shared encode for the whole scatter (and its hedges): the
         # block bytes build here and every per-partition send reuses them
         prep = self._prepare(qv, n)
@@ -739,7 +857,8 @@ class WorkerGateway:
             for pid in range(P):
                 rep = pset._route(pid)
                 gen = table[pid][rep.rid].generation
-                w = self._pick_worker(pid, rep.rid, generation=gen)
+                w = self._pick_worker(pid, rep.rid, generation=gen,
+                                      split=split)
                 if w is None:
                     calls.append((pid, rep, None, -1))
                 else:
@@ -754,7 +873,8 @@ class WorkerGateway:
                         res = self._await_partition(
                             pid, rep.rid, fut, rid, prep, k, nprobe,
                             deadline,
-                            generation=table[pid][rep.rid].generation)
+                            generation=table[pid][rep.rid].generation,
+                            split=split)
                 if res is None:
                     # the in-process degrade path, verbatim: this
                     # partition's slice computed on the front end's own
@@ -770,64 +890,153 @@ class WorkerGateway:
             return merge_partition_topk([(s, i) for s, i, _ in parts])
 
     # -- store-generation control (docs/SERVING.md) ------------------------
-    def broadcast_refresh(self, generation: int,
-                          wait_s: float = 0.0) -> Dict:
+    def broadcast_refresh(self, generation: int, wait_s: float = 0.0,
+                          split: Optional[int] = None,
+                          refresh_own: bool = True) -> Dict:
         """Tell every live worker to re-open the store and rebuild its
-        view (T_REFRESH carrying the target generation) — the wire
-        fleet's half of `SearchService.refresh()`: a store generation
-        swap no longer needs a worker restart. Until a worker ACKS with
-        its own T_REFRESH, routing treats it as generation-stale and the
-        fan-out serves its slice from the front end's local view, so the
-        swap stays byte-consistent while the fleet catches up. With
-        `wait_s` > 0 the call blocks up to that long for every live
-        worker's ack."""
+        view (T_REFRESH carrying the target generation — and, in elastic
+        mode, the split width to re-cut over) — the wire fleet's half of
+        `SearchService.refresh()`: a store generation swap no longer
+        needs a worker restart. Until a worker ACKS with its own
+        T_REFRESH, routing treats it as generation-stale (and, elastic,
+        split-stale) and the fan-out serves its slice from the front
+        end's local view, so the swap stays byte-consistent while the
+        fleet catches up. With `wait_s` > 0 the call blocks up to that
+        long for every live worker's ack. `split` defaults to the
+        routed table's width in elastic mode, 0 (unspecified: the
+        worker keeps its cut) otherwise; `refresh_own=False` skips the
+        private-pset rebuild when the caller (resplit) already did it."""
         svc = self._svc
-        if self._own_pset is not None:
+        if self._own_pset is not None and refresh_own:
             # single-view service: the gateway's private 1-partition set
             # must follow the store too, or its table (and the local
             # fallback views in it) would serve the old generation
             # forever while generation gating kept every worker
             # ineligible
             self._own_pset.refresh(svc.store)
+        if split is None:
+            split = (len(self.partition_set._view_table)
+                     if self._elastic else 0)
         with self._lock:
             workers = list(self._workers.values())
         age = self._alive_age_s()
         told = 0
         for w in workers:
-            if not w.alive(age) or w.generation == generation:
+            if not w.alive(age) or (w.generation == generation
+                                    and (split <= 0 or w.split == split)):
                 continue
             try:
                 with w.wlock:
-                    w.sender.send(T_REFRESH,
-                                  transport.encode_refresh(generation),
-                                  counter=svc._m_wire_bytes,
-                                  raw_counter=svc._m_wire_raw)
+                    w.sender.send(
+                        T_REFRESH,
+                        transport.encode_refresh(generation, split),
+                        counter=svc._m_wire_bytes,
+                        raw_counter=svc._m_wire_raw)
                 told += 1
             except OSError:
                 pass              # a dying worker re-registers fresh
         if wait_s > 0:
-            self.wait_for_generation(generation, timeout_s=wait_s)
+            self.wait_for_generation(generation, timeout_s=wait_s,
+                                     split=split)
         return {"workers_told": told,
-                "workers_stale": self.stale_workers(generation)}
+                "workers_stale": self.stale_workers(generation,
+                                                    split=split)}
 
-    def stale_workers(self, generation: int) -> int:
-        """Live workers whose view still serves another generation."""
+    def stale_workers(self, generation: int, split: int = 0) -> int:
+        """Live workers whose view still serves another generation (or,
+        with `split` > 0, another partition-split width)."""
         with self._lock:
             workers = list(self._workers.values())
         age = self._alive_age_s()
         return sum(1 for w in workers
-                   if w.alive(age) and w.generation != generation)
+                   if w.alive(age) and (w.generation != generation
+                                        or (split > 0
+                                            and w.split != split)))
 
     def wait_for_generation(self, generation: int,
-                            timeout_s: float = 30.0) -> bool:
-        """Block until no live worker lags `generation` — the fleet-wide
-        refresh barrier for tests/cli; False on timeout."""
-        t_end = time.perf_counter() + timeout_s
+                            timeout_s: float = 30.0,
+                            split: int = 0) -> bool:
+        """Block until no live worker lags `generation` (and `split`,
+        when > 0) — the fleet-wide refresh barrier for tests/cli; False
+        on timeout, after recording how long it waited and how many
+        workers stayed stale (`gateway_wait_timeout` event + stats()
+        counter)."""
+        t0 = time.perf_counter()
+        t_end = t0 + timeout_s
         while time.perf_counter() < t_end:
-            if self.stale_workers(generation) == 0:
+            if self.stale_workers(generation, split=split) == 0:
                 return True
             time.sleep(0.01)
-        return self.stale_workers(generation) == 0
+        stale = self.stale_workers(generation, split=split)
+        if stale == 0:
+            return True
+        self._note_wait_timeout(
+            "generation", time.perf_counter() - t0, timeout_s,
+            generation=int(generation), split=int(split), stale=stale,
+            live=len(self.live_workers()))
+        return False
+
+    # -- elastic membership (docs/SCALING.md "Scale-out tier") -------------
+    def _fleet_width(self) -> Optional[int]:
+        """The partition-split width the live fleet implies: one slice
+        per distinct live, non-draining partition index — but only when
+        those indices are exactly {0..W-1}. Membership changes at the
+        TAIL (spawn the next index, drain the highest); a gapped set
+        (a mid-fleet crash, an out-of-order spawn) returns None and the
+        split stays put — crash recovery is rejoin + local fallback,
+        never a re-cut under a hole."""
+        with self._lock:
+            workers = list(self._workers.values())
+        age = self._alive_age_s()
+        pids = {w.partition for w in workers
+                if w.alive(age) and not w.draining}
+        if not pids:
+            return None
+        width = max(pids) + 1
+        if pids != set(range(width)):
+            return None
+        return width
+
+    def _maybe_resplit(self, trigger: str) -> Optional[Dict]:
+        """Re-cut the partition split if the live fleet's width moved
+        (no-op unless serve.elastic)."""
+        if not self._elastic:
+            return None
+        width = self._fleet_width()
+        if width is None:
+            return None
+        with self._resplit_lock:
+            if width != len(self.partition_set._view_table):
+                return self._resplit(width, trigger)
+        return None
+
+    # holds-lock: _resplit_lock
+    def _resplit(self, width: int, trigger: str) -> Dict:
+        """The elastic re-cut: rebuild the front end's view table over
+        `width` partitions (`partition_shard_ranges` over the new fleet
+        size — deterministic, so every front end sharing the fleet cuts
+        identically), then broadcast the generation+split handoff. The
+        resize runs under the SAME svc._refresh_lock a store refresh
+        takes, and publishes the new table in one assignment — in-flight
+        scatters keep their snapshot of the old cut, new scatters see
+        the new one, and split gating keeps every worker unroutable
+        until it acks the new width, so no result set ever mixes
+        splits."""
+        svc = self._svc
+        pset = self.partition_set
+        old = len(pset._view_table)
+        with svc._refresh_lock:
+            pset.resize(svc.store, width)
+        generation = self._routed_generation(0)
+        info = self.broadcast_refresh(generation, split=width,
+                                      refresh_own=False)
+        with self._lock:
+            self._resplits += 1
+        svc.registry.event("fleet_resplit", {
+            "trigger": trigger, "from_partitions": old,
+            "to_partitions": width, "generation": generation,
+            "workers_told": info["workers_told"]})
+        return dict(info, partitions=width)
 
     # -- telemetry / lifecycle --------------------------------------------
     def stats(self) -> Dict:
@@ -836,16 +1045,23 @@ class WorkerGateway:
             registered = self._registered
             rpcs = self._rpcs
             fallbacks = self._rpc_fallbacks
+            resplits = self._resplits
+            wait_timeouts = self._wait_timeouts
+            workers = list(self._workers.values())
             compressing = sum(
-                1 for w in self._workers.values()
+                1 for w in workers
                 if not w.dead and w.flags & FLAG_WIRE_COMPRESS)
             breakers = list(self._breakers.values())
         return {
             "workers_live": len(self.live_workers()),
             "workers_registered": registered,
             "workers_compressing": compressing,
+            "workers_draining": sum(1 for w in workers
+                                    if not w.dead and w.draining),
             "rpcs": rpcs,
             "rpc_fallbacks": fallbacks,
+            "resplits": resplits,
+            "wait_timeouts": wait_timeouts,
             "breakers_open": sum(1 for b in breakers
                                  if b.state == "open"),
             "breaker_trips": sum(b.trips for b in breakers),
@@ -886,14 +1102,49 @@ class WorkerGateway:
 # the worker side
 # ---------------------------------------------------------------------------
 
+class _GatewayLink:
+    """One worker->gateway connection's session state. A PartitionWorker
+    serving N front ends runs one link per `--connect` endpoint: each
+    link owns its OWN socket, sender, negotiated capability flags,
+    intern slots, block cache, heartbeat thread, and reconnect
+    supervisor — per-gateway wire state stays isolated by construction
+    (the same invariant the per-connection intern tables rely on) while
+    every link serves the ONE shared view."""
+
+    def __init__(self, connect: Tuple[str, int], index: int):
+        self.connect = (connect[0], int(connect[1]))
+        self.index = int(index)
+        self.sock: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()  # serializes frame writes
+        self.sender: Optional[FrameSender] = None  # guarded-by: send_lock
+        # agreed capabilities — re-negotiated per connection, written
+        # and read only on this link's serve loop
+        self.flags = 0
+        # per-hop block cache: (query-block bytes, k, nprobe) -> (view,
+        # scores, ids, scan). Link serve-loop only. A hit replays ONLY
+        # if the cached view IS this request's snapshotted view object —
+        # identity, not equality — so a refresh or re-split swap makes
+        # every old entry unreachable without any cross-thread clearing.
+        self.block_cache: OrderedDict = OrderedDict()
+        self.sessions = 0   # completed dial+REGISTER rounds (serve loop)
+
+
 class PartitionWorker:
     """One partition replica serving its `PartitionSpec` slice over a
     socket. As a process: `cli partition-worker` (the production shape);
     in tests it also runs as a thread with its own service instance —
     either way it owns an independent restricted view built by the exact
-    `_build_view` the in-process replicas use."""
+    `_build_view` the in-process replicas use.
 
-    def __init__(self, cfg, store_dir: str, connect: Tuple[str, int],
+    Multi-front-end (docs/SCALING.md "Scale-out tier"): `connect` may be
+    a LIST of gateway endpoints — the worker registers with every one
+    and answers each over its own `_GatewayLink`, all serving the same
+    view. T_REFRESH from any gateway re-cuts/re-opens the shared view
+    (idempotent: a second gateway's broadcast for a state already served
+    just acks), so N front ends converge on one split without talking
+    to each other."""
+
+    def __init__(self, cfg, store_dir: str, connect,
                  partition: int, partitions: int, replica: int = 0,
                  mesh=None, preload_hbm_gb: float = 4.0,
                  heartbeat_s: Optional[float] = None,
@@ -904,7 +1155,13 @@ class PartitionWorker:
         self.partition = int(partition)
         self.partitions = int(partitions)
         self.replica = int(replica)
-        self.connect = (connect[0], int(connect[1]))
+        if connect and isinstance(connect[0], (list, tuple)):
+            endpoints = [(h, int(p)) for h, p in connect]
+        else:
+            endpoints = [(connect[0], int(connect[1]))]
+        self.connect = endpoints[0]   # primary endpoint (back-compat)
+        self._links = [_GatewayLink(ep, i)
+                       for i, ep in enumerate(endpoints)]
         self.heartbeat_s = (heartbeat_s if heartbeat_s is not None
                             else getattr(cfg.serve, "heartbeat_s", 0.5))
         # wire compression is ADVERTISED at REGISTER and only used after
@@ -918,13 +1175,7 @@ class PartitionWorker:
         self.result_cache = bool(
             getattr(cfg.serve, "result_cache", False)
             and getattr(cfg.serve, "result_cache_fleet", False))
-        self._flags = 0           # agreed capabilities (run-loop only)
-        # per-hop block cache: (query-block bytes, k, nprobe, store gen,
-        # index gen) -> (scores, ids, scan). Run-loop only (like _flags
-        # — _answer is only ever called from run()); sized to the intern
-        # table's order of magnitude, cleared on every view swap.
-        self._block_cache: OrderedDict = OrderedDict()  # run-loop only
-        self._block_cache_cap = 64
+        self._block_cache_cap = 64   # per-link block-cache entries
         # drill hook (tests, the bench hedge drill): added per-request
         # latency, so a deliberately slow replica provokes hedging
         self.slow_ms = float(slow_ms)
@@ -941,18 +1192,29 @@ class PartitionWorker:
         self.svc._preload_gb = preload_hbm_gb
         specs = make_partition_specs(store.shards(), self.partitions,
                                      hot_gb=cfg.serve.hot_postings_gb)
-        if self.partition >= len(specs):
+        if self.partition >= self.partitions:
             raise ValueError(
-                f"partition {self.partition} does not exist: the balanced "
-                f"split of this store yields {len(specs)} partitions")
-        self.spec = specs[self.partition]
+                f"partition {self.partition} does not exist: this worker "
+                f"was asked for a {self.partitions}-way split")
+        if self.partition < len(specs):
+            self.spec = specs[self.partition]
+        else:
+            # the balanced split clamps below the requested width (more
+            # workers than shards): an EMPTY slice is a valid elastic
+            # member — it serves nothing until a re-split assigns it rows
+            from dnn_page_vectors_tpu.infer.partition import PartitionSpec
+            self.spec = PartitionSpec(pid=self.partition, entries=(),
+                                      shard_indices=(), rows=0, hot_gb=0.0)
         self.view = self.svc._build_view(store,
                                          entries=list(self.spec.entries),
                                          hot_gb=self.spec.hot_gb)
-        self._sock: Optional[socket.socket] = None
-        self._wlock = threading.Lock()     # serializes frame writes
         self._stop = threading.Event()
-        self._sender: Optional[FrameSender] = None  # guarded-by: _wlock
+        # serializes the shared view/spec/split swap: T_REFRESH can now
+        # arrive on N link threads at once; the swap itself stays one
+        # reference assignment per field, the lock only orders rebuilds
+        # (and lets a duplicate refresh short-circuit to an ack)
+        # lock-order: PartitionWorker._swap_lock < _GatewayLink.send_lock
+        self._swap_lock = threading.Lock()
         # self-healing (docs/ROBUSTNESS.md "Network failure model"): on
         # connection loss run() re-dials with exponential backoff +
         # jitter instead of exiting; serve.reconnect=False restores the
@@ -965,33 +1227,51 @@ class PartitionWorker:
         # seeded per-replica jitter: deterministic under test, still
         # decorrelated across a fleet restarting together
         self._rng = random.Random(1 + (self.partition << 8) | self.replica)
-        self.sessions = 0   # completed dial+REGISTER rounds (run loop only)
+
+    @property
+    def sessions(self) -> int:
+        """Completed dial+REGISTER rounds, across every gateway link."""
+        return sum(ln.sessions for ln in self._links)
 
     # -- lifecycle ---------------------------------------------------------
-    def _heartbeat_loop(self) -> None:
+    def _heartbeat_loop(self, link: _GatewayLink) -> None:
         while not self._stop.wait(self.heartbeat_s):
             try:
-                with self._wlock:
-                    if self._sender is None:
+                with link.send_lock:
+                    if link.sender is None:
                         return    # between sessions: this beat's done
-                    self._sender.send(T_HEARTBEAT)
+                    link.sender.send(T_HEARTBEAT)
             except OSError:
                 return
 
     def run(self) -> None:
         """Supervised serve loop (docs/ROBUSTNESS.md "Network failure
-        model"): dial + REGISTER + serve; on EOF / torn frame / socket
-        error, re-dial with exponential backoff + jitter (base
-        `serve.reconnect_base_s`, cap `serve.reconnect_max_s`) and
-        re-REGISTER with the CURRENT view generation, so a transient
-        gateway blip costs one reconnect instead of the replica. Exits
-        on a clean T_BYE (deregistered), stop(), or — with
-        serve.reconnect off — the first connection loss. Blocking — the
-        process entry point."""
+        model"): dial + REGISTER + serve on every gateway link; on EOF /
+        torn frame / socket error a link re-dials with exponential
+        backoff + jitter (base `serve.reconnect_base_s`, cap
+        `serve.reconnect_max_s`) and re-REGISTERs with the CURRENT view
+        generation, so a transient gateway blip costs one reconnect
+        instead of the replica. A link exits on its gateway's clean
+        T_BYE (deregistered), stop(), or — with serve.reconnect off —
+        the first connection loss; run() returns when EVERY link has
+        exited (one front end restarting never takes the worker down
+        for its siblings). Blocking — the process entry point."""
+        extra = [threading.Thread(target=self._run_link, args=(ln,),
+                                  daemon=True,
+                                  name=f"worker-p{self.partition}"
+                                       f"r{self.replica}-g{ln.index}")
+                 for ln in self._links[1:]]
+        for t in extra:
+            t.start()
+        self._run_link(self._links[0])
+        for t in extra:
+            t.join()
+
+    def _run_link(self, link: _GatewayLink) -> None:
         failures = 0
         while not self._stop.is_set():
             try:
-                if self._serve_session():
+                if self._serve_session(link):
                     break         # clean T_BYE: deregistered on purpose
                 failures = 0      # a registered session resets the ramp
             except (FrameError, OSError):
@@ -1006,19 +1286,20 @@ class PartitionWorker:
             if self._stop.wait(delay):
                 break
 
-    def _dial(self) -> socket.socket:
+    def _dial(self, link: _GatewayLink) -> socket.socket:
         """Dial + REGISTER under the wire retry profile
         (faults.retry_wire — idempotent: a re-REGISTER replaces the
         previous registration), advertising the current view
         generation."""
         def _connect() -> socket.socket:
             faults.active().check("worker_dial")
-            sock = socket.create_connection(self.connect)
+            sock = socket.create_connection(link.connect)
             # an OSError on setsockopt or the REGISTER write must close
             # the socket on its way out (the retry dials fresh), not
             # leak it (graftcheck lifecycle rule)
             try:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                view = self.view
                 transport.write_frame(
                     sock, T_REGISTER,
                     transport.encode_register(
@@ -1027,7 +1308,7 @@ class PartitionWorker:
                                if self.wire_compress else 0)
                         | (FLAG_RESULT_CACHE
                            if self.result_cache else 0),
-                        generation=self.view.generation))
+                        generation=view.generation))
             except OSError:
                 try:
                     sock.close()
@@ -1039,24 +1320,25 @@ class PartitionWorker:
                                  backoff=self.reconnect_base_s,
                                  max_backoff=self.reconnect_max_s)
 
-    def _serve_session(self) -> bool:
-        """One dial + REGISTER + serve round. -> True on a clean T_BYE,
-        False on EOF at a frame boundary (the supervisor re-dials); torn
-        frames and socket errors propagate to the supervisor's backoff
-        path."""
-        sock = self._dial()
+    def _serve_session(self, link: _GatewayLink) -> bool:
+        """One dial + REGISTER + serve round on `link`. -> True on a
+        clean T_BYE, False on EOF at a frame boundary (the supervisor
+        re-dials); torn frames and socket errors propagate to the
+        supervisor's backoff path."""
+        sock = self._dial(link)
         hb: Optional[threading.Thread] = None
         slots: Dict[int, bytes] = {}   # per-connection intern table
         bye = False
         try:
-            self._sock = sock
-            self.sessions += 1
-            self._flags = 0            # re-negotiated per connection
-            with self._wlock:
-                self._sender = FrameSender(sock)
-            hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+            link.sock = sock
+            link.sessions += 1
+            link.flags = 0             # re-negotiated per connection
+            with link.send_lock:
+                link.sender = FrameSender(sock)
+            hb = threading.Thread(target=self._heartbeat_loop,
+                                  args=(link,), daemon=True,
                                   name=f"worker-p{self.partition}"
-                                       f"r{self.replica}-hb")
+                                       f"r{self.replica}-g{link.index}-hb")
             hb.start()
             while not self._stop.is_set():
                 frame = transport.read_frame(sock)
@@ -1064,13 +1346,14 @@ class PartitionWorker:
                     break
                 ftype, payload = frame
                 if ftype in (T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF):
-                    self._answer(ftype, payload, slots)
+                    self._answer(link, ftype, payload, slots)
                 elif ftype == T_HELLO:
                     # the gateway's negotiation ack: these capabilities
                     # are agreed for the rest of the connection
-                    self._flags = transport.decode_hello(payload)
+                    link.flags = transport.decode_hello(payload)
                 elif ftype == T_REFRESH:
-                    self._refresh(transport.decode_refresh(payload))
+                    gen, parts = transport.decode_refresh(payload)
+                    self._refresh(link, gen, parts)
                 elif ftype == T_BYE:
                     bye = True
                     break
@@ -1082,55 +1365,69 @@ class PartitionWorker:
                 sock.close()
             except OSError:
                 pass
-            with self._wlock:
-                self._sender = None
+            with link.send_lock:
+                link.sender = None
             if hb is not None:
                 hb.join(timeout=self.heartbeat_s + 2.0)
         return bye
 
-    def _refresh(self, generation: int) -> None:
+    def _refresh(self, link: _GatewayLink, generation: int,
+                 partitions: int = 0) -> None:
         """The T_REFRESH control path: re-open the store, rebuild this
-        replica's restricted view over the (possibly re-balanced) shard
-        split, swap it in with one reference assignment, and ack with
-        the generation now served — byte-identical to a worker restarted
-        against the same store, with no restart. A rebuild failure keeps
-        the OLD view serving (the gateway routes around the stale
-        generation until a later refresh lands)."""
+        replica's restricted view over the shard split — re-cut over
+        `partitions` when the extended frame carries a width (elastic
+        re-split), the current width otherwise — swap it in with one
+        reference assignment, and ack with the (generation, width) now
+        served: byte-identical to a worker restarted against the same
+        store, with no restart. With N gateways the rebuild is
+        serialized and IDEMPOTENT — a second front end's broadcast for a
+        state this worker already serves short-circuits straight to the
+        ack. A rebuild failure keeps the OLD view serving (the gateway
+        routes around the stale generation until a later refresh
+        lands)."""
         from dnn_page_vectors_tpu.infer.partition import (
             make_partition_specs)
         from dnn_page_vectors_tpu.infer.vector_store import VectorStore
-        try:
-            new_store = VectorStore(self.svc.store.directory)
-            specs = make_partition_specs(
-                new_store.shards(), self.partitions,
-                hot_gb=self.svc.cfg.serve.hot_postings_gb)
-            if self.partition < len(specs):
-                spec = specs[self.partition]
-            else:            # the balanced split shrank under this slice
-                from dnn_page_vectors_tpu.infer.partition import (
-                    PartitionSpec)
-                spec = PartitionSpec(pid=self.partition, entries=(),
-                                     shard_indices=(), rows=0, hot_gb=0.0)
-            view = self.svc._build_view(new_store, reuse=self.view,
-                                        entries=list(spec.entries),
-                                        hot_gb=spec.hot_gb)
-            self.spec = spec
-            self.view = view     # THE swap: one reference assignment
-            self.svc.store = new_store
-            # the block cache keys carry the old generations — clear
-            # eagerly rather than letting dead entries squat the LRU
-            self._block_cache.clear()
-        except Exception:  # noqa: BLE001 — keep serving the old view
-            pass
-        try:
-            with self._wlock:
-                self._sender.send(T_REFRESH, transport.encode_refresh(
-                    self.view.generation))
-        except OSError:
-            pass
+        with self._swap_lock:
+            width = int(partitions) if partitions > 0 else self.partitions
+            try:
+                if (width != self.partitions
+                        or self.view.generation != int(generation)):
+                    new_store = VectorStore(self.svc.store.directory)
+                    specs = make_partition_specs(
+                        new_store.shards(), width,
+                        hot_gb=self.svc.cfg.serve.hot_postings_gb)
+                    if self.partition < len(specs):
+                        spec = specs[self.partition]
+                    else:    # the balanced split clamps under this slice
+                        from dnn_page_vectors_tpu.infer.partition import (
+                            PartitionSpec)
+                        spec = PartitionSpec(pid=self.partition,
+                                             entries=(), shard_indices=(),
+                                             rows=0, hot_gb=0.0)
+                    view = self.svc._build_view(new_store, reuse=self.view,
+                                                entries=list(spec.entries),
+                                                hot_gb=spec.hot_gb)
+                    self.spec = spec
+                    self.view = view   # THE swap: one reference assignment
+                    self.partitions = width
+                    self.svc.store = new_store
+                    # this link's block cache self-invalidates (hits
+                    # check view identity), but drop it eagerly anyway
+                    # rather than letting dead entries squat the LRU;
+                    # other links' caches age out on their own loops
+                    link.block_cache.clear()
+            except Exception:  # noqa: BLE001 — keep serving the old view
+                pass
+            try:
+                with link.send_lock:
+                    link.sender.send(T_REFRESH, transport.encode_refresh(
+                        self.view.generation, self.partitions))
+            except OSError:
+                pass
 
     # graftcheck: hot
-    def _answer(self, ftype: int, payload: bytes,
+    def _answer(self, link: _GatewayLink, ftype: int, payload: bytes,
                 slots: Dict[int, bytes]) -> None:
         req = transport.decode_vquery_any(ftype, payload, slots)
         t0 = time.perf_counter()
@@ -1139,30 +1436,34 @@ class PartitionWorker:
             if self.slow_ms > 0:
                 time.sleep(self.slow_ms / 1000.0)
             k = req.k or self.svc.cfg.eval.recall_k
+            # ONE view snapshot answers this request — the compute, the
+            # cache hit check, and the cache fill all reference it, so a
+            # concurrent refresh/re-split swap can't mix states
+            view = self.view
             ckey = None
             hit = None
-            if self._flags & FLAG_RESULT_CACHE:
-                # per-hop block cache: the generation-qualified key makes
-                # a replayed answer byte-identical to a recompute on THIS
-                # view — and unreachable the moment a refresh swaps it
-                idx = self.view.index
-                ckey = (req.qv.tobytes(), k, int(req.nprobe or 0),
-                        int(self.view.generation),  # graftcheck: off=host-sync -- generations are host ints, never device arrays
-                        int(idx.index_generation) if idx is not None  # graftcheck: off=host-sync -- generations are host ints, never device arrays
-                        else -1)
-                hit = self._block_cache.get(ckey)
-                if hit is not None:
-                    self._block_cache.move_to_end(ckey)
+            if link.flags & FLAG_RESULT_CACHE:
+                # per-hop block cache: a hit replays only when the
+                # cached entry was computed on THIS view object
+                # (identity check below), which makes it byte-identical
+                # to a recompute — and unreachable the moment a refresh
+                # or re-split swaps the view
+                ckey = (req.qv.tobytes(), k, int(req.nprobe or 0))
+                hit = link.block_cache.get(ckey)
+                if hit is not None and hit[0] is view:
+                    link.block_cache.move_to_end(ckey)
+                else:
+                    hit = None
             if hit is not None:
-                scores, ids, scan = hit
+                _, scores, ids, scan = hit
             else:
                 scores, ids, scan = self.svc._topk_view(
-                    self.view, req.qv, req.qv.shape[0], k,
+                    view, req.qv, req.qv.shape[0], k,
                     req.nprobe or None)
                 if ckey is not None:
-                    self._block_cache[ckey] = (scores, ids, scan)
-                    while len(self._block_cache) > self._block_cache_cap:
-                        self._block_cache.popitem(last=False)
+                    link.block_cache[ckey] = (view, scores, ids, scan)
+                    while len(link.block_cache) > self._block_cache_cap:
+                        link.block_cache.popitem(last=False)
             if req.deadline_ms > 0 and \
                     (time.perf_counter() - t0) * 1000.0 > req.deadline_ms:
                 # the budget died during compute: a late answer is waste
@@ -1171,7 +1472,7 @@ class PartitionWorker:
                 parts = (transport.encode_shed(
                     req.req_id, transport.SHED_DEADLINE,
                     "deadline expired during partition compute"),)
-            elif self._flags & FLAG_WIRE_COMPRESS:
+            elif link.flags & FLAG_WIRE_COMPRESS:
                 rtype = T_RESULT_C
                 parts = (transport.encode_result_c(req.req_id, scores,
                                                    ids, scan_bytes=scan),)
@@ -1186,8 +1487,8 @@ class PartitionWorker:
             rtype = T_ERROR
             parts = (transport.encode_error(req.req_id,
                                             f"{type(e).__name__}: {e}"),)
-        with self._wlock:
-            self._sender.send(rtype, *parts)
+        with link.send_lock:
+            link.sender.send(rtype, *parts)
 
     @staticmethod
     def _tear(sock: Optional[socket.socket]) -> None:
@@ -1207,17 +1508,45 @@ class PartitionWorker:
             pass
 
     def stop(self) -> None:
-        """Abrupt local shutdown (tests' stand-in for kill -9): tear the
-        socket out from under the serve loop."""
+        """Abrupt local shutdown (tests' stand-in for kill -9): tear
+        every link's socket out from under its serve loop."""
         self._stop.set()
-        self._tear(self._sock)
+        for ln in self._links:
+            self._tear(ln.sock)
 
     def kill_connection(self) -> None:
-        """Drill hook (tests, the bench chaos drill): tear the live
-        connection out from under the serve loop WITHOUT stopping the
-        worker — the supervised run() loop re-dials and re-REGISTERs,
+        """Drill hook (tests, the bench chaos drill): tear every live
+        connection out from under its serve loop WITHOUT stopping the
+        worker — the supervised link loops re-dial and re-REGISTER,
         which is exactly the recovery path the chaos drills measure."""
-        self._tear(self._sock)
+        for ln in self._links:
+            self._tear(ln.sock)
+
+    def drain(self, wait_s: Optional[float] = None) -> None:
+        """Graceful exit (docs/SCALING.md "Scale-out tier" drain rules):
+        announce T_DRAIN on every link — each gateway stops routing this
+        worker NEW work immediately and serves its slice from the local
+        view (an elastic front end also shrinks the split around a
+        drained tail index) — wait `wait_s` (default one heartbeat) for
+        in-flight answers to flush, then BYE each gateway and stop. The
+        announce-then-BYE split is what makes the handoff lossless: no
+        request is ever in flight to a worker that has already gone."""
+        for ln in self._links:
+            try:
+                with ln.send_lock:
+                    if ln.sender is not None:
+                        ln.sender.send(T_DRAIN)
+            except OSError:
+                pass              # that gateway already lost us
+        time.sleep(self.heartbeat_s if wait_s is None else float(wait_s))
+        for ln in self._links:
+            try:
+                with ln.send_lock:
+                    if ln.sender is not None:
+                        ln.sender.send(T_BYE)
+            except OSError:
+                pass
+        self.stop()
 
 
 def run_partition_worker(cfg, store_dir: str, connect: str, partition: int,
@@ -1225,10 +1554,15 @@ def run_partition_worker(cfg, store_dir: str, connect: str, partition: int,
                          preload_hbm_gb: float = 4.0) -> Dict:
     """`cli partition-worker` entry: build the worker (store + restricted
     view + mesh, NO model or checkpoint), print one ready line, serve
-    until the gateway hangs up. Returns the exit record."""
-    host, _, port = connect.rpartition(":")
+    until every gateway hangs up. `connect` is one `host:port` — or a
+    comma-separated list of them for a worker shared by N front ends.
+    Returns the exit record."""
+    endpoints = []
+    for one in connect.split(","):
+        host, _, port = one.strip().rpartition(":")
+        endpoints.append((host or "127.0.0.1", int(port)))
     slow = float(os.environ.get("DPV_WORKER_SLOW_MS", "0") or 0.0)
-    worker = PartitionWorker(cfg, store_dir, (host or "127.0.0.1", int(port)),
+    worker = PartitionWorker(cfg, store_dir, endpoints,
                              partition=partition, partitions=partitions,
                              replica=replica, preload_hbm_gb=preload_hbm_gb,
                              slow_ms=slow)
@@ -1236,6 +1570,7 @@ def run_partition_worker(cfg, store_dir: str, connect: str, partition: int,
         "partition_worker": worker.partition,
         "partitions": worker.partitions,
         "replica": worker.replica,
+        "gateways": len(endpoints),
         "shards": list(worker.spec.shard_indices),
         "rows": worker.spec.rows,
         "pid": os.getpid(),

@@ -1846,6 +1846,22 @@ class SearchService:
                 self._m_queue_wait.window_percentile(99), 3),
         }
 
+    def autoscale_signals(self) -> Dict[str, float]:
+        """The two windowed pressure signals the maintenance autoscale
+        pillar ladders on (docs/SCALING.md "Scale-out tier"): queue-wait
+        p99 over the telemetry window — requests stacking faster than
+        dispatches drain — and the deadline-shed rate — admission
+        already refusing work. Both read the SAME instruments the
+        adaptive batcher and the admission door feed, so the policy
+        sees exactly what the serving path saw."""
+        return {
+            "queue_wait_p99_ms": round(
+                self._m_queue_wait.window_percentile(99), 3),
+            "queue_wait_samples": float(self._m_queue_wait.window_count()),
+            "shed_rate": round(self._m_deadline_shed.rate(), 4),
+            "window_s": self._window_s,
+        }
+
     # -- exposition (docs/OBSERVABILITY.md) --------------------------------
     def metrics_snapshot(self) -> Dict:
         """JSON snapshot endpoint: the flat metrics() record plus the full

@@ -72,12 +72,18 @@ class SearchServer:
     (`start_background()` — the cli/loadgen shape; `close()` stops it)."""
 
     def __init__(self, svc, host: Optional[str] = None,
-                 port: Optional[int] = None, executor_workers: int = 32):
+                 port: Optional[int] = None, executor_workers: int = 32,
+                 front_end: int = 0):
         serve_cfg = getattr(svc.cfg, "serve", None)
         listen = (getattr(serve_cfg, "listen", "127.0.0.1:0")
                   if serve_cfg is not None else "127.0.0.1:0")
         cfg_host, cfg_port = parse_listen(listen)
         self.svc = svc
+        # which front end of a scale-out tier this is (docs/SCALING.md
+        # "Scale-out tier"): purely an identity label — it threads into
+        # thread names and per-front-end trial records so N otherwise
+        # interchangeable servers stay tellable apart in telemetry
+        self.front_end = int(front_end)
         self.host = host if host is not None else cfg_host
         self.port = port if port is not None else cfg_port
         # serve.wire_compress gates what this end ADVERTISES: with it off
@@ -137,8 +143,9 @@ class SearchServer:
                 loop.run_until_complete(server.wait_closed())
                 loop.close()
 
-        self._thread = threading.Thread(target=_run, daemon=True,
-                                        name="serve-socket-loop")
+        self._thread = threading.Thread(
+            target=_run, daemon=True,
+            name=f"serve-socket-loop-fe{self.front_end}")
         self._thread.start()
         started.wait()
         if failed:
@@ -414,8 +421,11 @@ class SearchServer:
 
 
 def serve_in_background(svc, host: Optional[str] = None,
-                        port: Optional[int] = None) -> SearchServer:
+                        port: Optional[int] = None,
+                        front_end: int = 0) -> SearchServer:
     """One-call server hosting for cli/bench/tests: binds (serve.listen
     unless overridden), runs the loop on a daemon thread, returns the
-    handle (`.host` / `.port` / `.close()`)."""
-    return SearchServer(svc, host=host, port=port).start_background()
+    handle (`.host` / `.port` / `.close()`). `front_end` labels this
+    server's slot in a scale-out tier (cli loadtest --front-ends)."""
+    return SearchServer(svc, host=host, port=port,
+                        front_end=front_end).start_background()

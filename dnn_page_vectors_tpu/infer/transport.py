@@ -54,9 +54,17 @@ compressing and raw peers interoperate on one gateway):
     T_HELLO      capability exchange on the client edge (the RPC hop
                  negotiates via REGISTER flags + a HELLO ack)
     T_REFRESH    control: ask a worker to re-open the store and rebuild
-                 its view (payload = the target store generation); the
-                 worker acks with its own T_REFRESH carrying the
-                 generation it now serves
+                 its view (payload = the target store generation, plus —
+                 extended form — the fleet's partition-split width for
+                 elastic re-splits); the worker acks with its own
+                 T_REFRESH carrying the generation and split it now
+                 serves. Like REGISTER, the decoder accepts both the
+                 legacy 8-byte and the extended 12-byte form, so a
+                 pre-elastic peer interoperates unchanged
+    T_DRAIN      control: a worker announces it is draining — the
+                 gateway stops routing to it (its slice falls back to
+                 the local view) and the worker BYEs once told traffic
+                 has stopped coming
 
 Fleet result-cache extensions (negotiated via FLAG_RESULT_CACHE — see
 docs/SERVING.md "Result cache"):
@@ -124,10 +132,11 @@ T_HELLO = 12                  # capability exchange (flags byte)
 T_REFRESH = 13                # view-refresh control / generation ack
 T_CACHE_LOOKUP = 14           # result-cache probe (key -> RESULT / miss)
 T_CACHE_PUT = 15              # result-cache share (key + one result row)
+T_DRAIN = 16                  # worker drain announcement (empty payload)
 
 _TYPES = {T_QUERY, T_VQUERY, T_RESULT, T_SHED, T_ERROR, T_REGISTER,
           T_HEARTBEAT, T_BYE, T_RESULT_C, T_VQUERY_PUT, T_VQUERY_REF,
-          T_HELLO, T_REFRESH, T_CACHE_LOOKUP, T_CACHE_PUT}
+          T_HELLO, T_REFRESH, T_CACHE_LOOKUP, T_CACHE_PUT, T_DRAIN}
 
 # capability flags (REGISTER / HELLO negotiation)
 FLAG_WIRE_COMPRESS = 0x01     # peer speaks T_RESULT_C + T_VQUERY_PUT/REF
@@ -153,7 +162,8 @@ _REGISTER_HEAD = struct.Struct("!IIQ")    # partition, replica, pid (legacy)
 _REGISTER_HEAD2 = struct.Struct("!IIQBQ")  # ... + flags, store generation
 _SLOT = struct.Struct("!H")               # intern slot id
 _HELLO_HEAD = struct.Struct("!B")         # capability flags
-_REFRESH_HEAD = struct.Struct("!Q")       # store generation
+_REFRESH_HEAD = struct.Struct("!Q")       # store generation (legacy)
+_REFRESH_HEAD2 = struct.Struct("!QI")     # ... + partition-split width
 # result-cache key head: req id, k, nprobe, store generation, index
 # generation (signed; -1 = the view serves without an index), text len
 _CACHE_HEAD = struct.Struct("!QiiQqH")
@@ -555,14 +565,27 @@ def decode_hello(payload: bytes) -> int:
     return _HELLO_HEAD.unpack(payload)[0]
 
 
-def encode_refresh(generation: int) -> bytes:
+def encode_refresh(generation: int, partitions: int = 0) -> bytes:
+    """Refresh control / ack. `partitions` > 0 ships the extended form
+    carrying the fleet's partition-split width (elastic re-splits,
+    docs/SCALING.md "Scale-out tier"); 0 keeps the legacy 8-byte frame a
+    pre-elastic peer understands — the same mixed-fleet dual-size
+    pattern REGISTER uses."""
+    if partitions > 0:
+        return _REFRESH_HEAD2.pack(int(generation), int(partitions))
     return _REFRESH_HEAD.pack(int(generation))
 
 
-def decode_refresh(payload: bytes) -> int:
-    if len(payload) != _REFRESH_HEAD.size:
-        raise FrameError("refresh frame has the wrong size")
-    return _REFRESH_HEAD.unpack(payload)[0]
+def decode_refresh(payload: bytes) -> Tuple[int, int]:
+    """-> (generation, partitions). Accepts the legacy 8-byte form
+    (partitions reported as 0 = unspecified, keep the current split) and
+    the extended 12-byte form."""
+    if len(payload) == _REFRESH_HEAD.size:
+        return _REFRESH_HEAD.unpack(payload)[0], 0
+    if len(payload) == _REFRESH_HEAD2.size:
+        gen, parts = _REFRESH_HEAD2.unpack(payload)
+        return gen, parts
+    raise FrameError("refresh frame has the wrong size")
 
 
 # -- fleet result cache (docs/SERVING.md "Result cache") --------------------

@@ -12,13 +12,14 @@ Entry points: `cli loadtest` (one-shot report), the bench `slo` phase
 marker).
 """
 from dnn_page_vectors_tpu.loadgen.driver import (
-    find_qps_at_p99, run_trial, snapshot_line)
+    BalancedClient, find_qps_at_p99, run_trial, snapshot_line)
 from dnn_page_vectors_tpu.loadgen.workload import (
     DEFAULT_PROFILE, SHAPES, BurstWorkload, ClosedLoopWorkload, Mutator,
     PoissonWorkload, QueryMix, Request, Workload, make_workload)
 
 __all__ = [
-    "BurstWorkload", "ClosedLoopWorkload", "DEFAULT_PROFILE", "Mutator",
+    "BalancedClient", "BurstWorkload", "ClosedLoopWorkload",
+    "DEFAULT_PROFILE", "Mutator",
     "PoissonWorkload", "QueryMix", "Request", "SHAPES", "Workload",
     "find_qps_at_p99", "make_workload", "run_trial", "snapshot_line",
 ]

@@ -79,13 +79,93 @@ def snapshot_line(svc, extra: Optional[Dict] = None) -> str:
     return json.dumps({k: v for k, v in rec.items() if v is not None})
 
 
+class BalancedClient:
+    """Client-side balancer over N front ends (docs/SCALING.md
+    "Scale-out tier").
+
+    Wraps one search client per front end and spreads `search()` calls
+    across them, so a multi-front-end loadtest hammers the tier as ONE
+    unit. Two seeded policies:
+
+      * ``round_robin`` — deterministic rotation starting at
+        ``seed % n``; with a fixed workload seed the (request -> front
+        end) assignment replays exactly;
+      * ``least_loaded`` — pick the front end with the fewest in-flight
+        requests; ties break by the same seeded rotation so the policy
+        stays deterministic under a synchronous (workers=0) trial.
+
+    The balancer only routes — every measured number still reads from
+    each front end's OWN registry (`run_trial`'s `front_ends=` block),
+    keeping the driver's one-instrument measurement discipline.
+    """
+
+    POLICIES = ("round_robin", "least_loaded")
+
+    def __init__(self, clients: Sequence, policy: str = "round_robin",
+                 seed: int = 0):
+        if not clients:
+            raise ValueError("BalancedClient needs at least one client")
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"unknown balance policy {policy!r} (want one of "
+                f"{self.POLICIES})")
+        self.clients = list(clients)
+        self.policy = policy
+        self._lock = threading.Lock()
+        n = len(self.clients)
+        self._next = int(seed) % n            # guarded-by: _lock
+        self._inflight = [0] * n              # guarded-by: _lock
+        self._sent = [0] * n                  # guarded-by: _lock
+        self._errors = [0] * n                # guarded-by: _lock
+
+    def _pick(self) -> int:
+        with self._lock:
+            n = len(self.clients)
+            if self.policy == "least_loaded":
+                # tie-break by seeded rotation distance so equal-load
+                # picks stay deterministic
+                nxt = self._next
+                i = min(range(n),
+                        key=lambda j: (self._inflight[j], (j - nxt) % n))
+            else:
+                i = self._next
+            self._next = (i + 1) % n
+            self._inflight[i] += 1
+            self._sent[i] += 1
+            return i
+
+    def search(self, query, k: int = 10, nprobe: Optional[int] = None):
+        i = self._pick()
+        try:
+            return self.clients[i].search(query, k=k, nprobe=nprobe)
+        except Exception:
+            with self._lock:
+                self._errors[i] += 1
+            raise
+        finally:
+            with self._lock:
+                self._inflight[i] -= 1
+
+    def stats(self) -> Dict:
+        """Per-front-end routing tallies (client-side view; the
+        authoritative latency numbers come from each front end's
+        registry)."""
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "sent": list(self._sent),
+                "errors": list(self._errors),
+            }
+
+
 def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
               *, duration_s: float = 10.0, warmup_s: float = 0.0,
               workers: int = 16, mutator: Optional[Mutator] = None,
               clock: Callable[[], float] = time.monotonic,
               sleep: Callable[[float], None] = time.sleep,
               progress: Optional[Callable[[str], None]] = None,
-              progress_every_s: float = 0.0, client=None) -> Dict:
+              progress_every_s: float = 0.0, client=None,
+              front_ends: Optional[Sequence] = None) -> Dict:
     """One timed trial at one offered load; returns the trial record.
 
     `offered` is a rate (qps) for open-loop workloads and a worker count
@@ -96,7 +176,16 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
     `search(query, k, nprobe)` shape) reroutes the ISSUE path over the
     wire while every measured number still reads from `svc`'s registry —
     qps@p99 then covers the full network path: framing, admission,
-    batcher, RPC fan-out, and the socket round trip back."""
+    batcher, RPC fan-out, and the socket round trip back.
+
+    `front_ends` (a sequence of SearchService, `svc` first) turns the
+    trial into a TIER measurement (docs/SCALING.md "Scale-out tier"):
+    `client` should be a `BalancedClient` spreading load across them,
+    and the record's headline numbers become tier aggregates — achieved
+    qps is the SUM of the per-front-end window qps, p99 the MAX (the
+    tier is only as fast as its slowest member), error rate the
+    qps-weighted mean — with a per-front-end block riding along so an
+    imbalance or a single hot front end is attributable."""
     ev0 = len(svc.registry.events()) if hasattr(svc, "registry") else 0
     mut0 = mutator.calls if mutator is not None else 0
     m0 = svc.metrics()
@@ -213,6 +302,31 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         "events": [{"event": e["event"], "attrs": e["attrs"],
                     "trace_id": e.get("trace_id")} for e in events],
     }
+    if front_ends is not None and len(front_ends) > 1:
+        # scale-out tier (docs/SCALING.md): per-front-end qps/p99 block
+        # mirrors the partitions block — each row reads that front end's
+        # OWN registry — and the headline numbers become tier aggregates
+        fes = []
+        for i, fe in enumerate(front_ends):
+            fm = fe.metrics() if fe is not svc else m
+            fes.append({
+                "front_end": i,
+                "qps": fm.get("serve_window_qps", 0.0),
+                "p50_ms": fm.get("serve_window_p50_ms", 0.0),
+                "p99_ms": fm.get("serve_window_p99_ms", 0.0),
+                "error_rate": fm.get("serve_window_error_rate", 0.0),
+            })
+        tier_qps = sum(f["qps"] for f in fes)
+        rec["front_ends"] = fes
+        rec["achieved_qps"] = round(tier_qps, 3)
+        rec["p99_ms"] = max(f["p99_ms"] for f in fes)
+        rec["p50_ms"] = max(f["p50_ms"] for f in fes)
+        rec["error_rate"] = (
+            round(sum(f["error_rate"] * f["qps"] for f in fes)
+                  / tier_qps, 4) if tier_qps else
+            max(f["error_rate"] for f in fes))
+        if isinstance(client, BalancedClient):
+            rec["balance"] = client.stats()
     if "partitions" in m:
         # partitioned serving (docs/SCALING.md): the per-partition
         # qps/p99/shed block + routing counters ride each trial record,
@@ -296,7 +410,8 @@ def find_qps_at_p99(svc, workload: Workload, queries: Sequence[str],
                     clock: Callable[[], float] = time.monotonic,
                     sleep: Callable[[float], None] = time.sleep,
                     progress: Optional[Callable[[str], None]] = None,
-                    progress_every_s: float = 0.0, client=None) -> Dict:
+                    progress_every_s: float = 0.0, client=None,
+                    front_ends: Optional[Sequence] = None) -> Dict:
     """Binary-search offered load for the max sustained QPS meeting the
     p99 target. Doubling phase brackets the cliff, bisection sharpens it;
     `qps_at_p99` is the best ACHIEVED qps among passing trials (what the
@@ -309,7 +424,8 @@ def find_qps_at_p99(svc, workload: Workload, queries: Sequence[str],
         tr = run_trial(svc, workload, load, queries, duration_s=duration_s,
                        warmup_s=warmup_s, workers=workers, mutator=mutator,
                        clock=clock, sleep=sleep, progress=progress,
-                       progress_every_s=progress_every_s, client=client)
+                       progress_every_s=progress_every_s, client=client,
+                       front_ends=front_ends)
         tr["met"] = _meets(tr, p99_target_ms, max_error_rate, sustain_frac)
         trials.append(tr)
         if progress is not None:

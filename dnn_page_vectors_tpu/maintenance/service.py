@@ -21,7 +21,14 @@ flips):
     (dirs the pointer moved off), and compaction debris a crashed run
     left behind. Old artifacts are deleted one full cycle after they go
     stale, so in-flight readers on the previous view never lose a file
-    mid-query.
+    mid-query;
+  * **autoscaler** (docs/SCALING.md "Scale-out tier") — ladder the
+    worker-fleet size off the serving telemetry: windowed queue-wait p99
+    or deadline-shed rate over the up-thresholds spawns the next tail
+    worker, sustained calm drains the highest one — acting through
+    operator-attached hooks (`attach_scaler`), observable-only without
+    them, and rate-limited by `maintenance.autoscale_cooldown_s` so a
+    resize's own dip never reads as fresh pressure.
 
 Every mutation goes through the manifest writers (`_write_shard_files`,
 `_atomic_dump`, `set_index_dir`); worker exceptions are counted
@@ -71,7 +78,7 @@ class MaintenanceService:
     service's `full_rebuilds` — the acceptance pin that rebuilds happen
     ONLY here, never on the refresh caller."""
 
-    PILLARS = ("compaction", "rebuild", "janitor")
+    PILLARS = ("compaction", "rebuild", "janitor", "autoscale")
 
     def __init__(self, cfg, store_dir: str, mesh, svc=None, registry=None):
         self._cfg = cfg
@@ -86,6 +93,36 @@ class MaintenanceService:
                          if m is not None else 0.2)
         self._interval_s = (getattr(m, "interval_s", 5.0)
                             if m is not None else 5.0)
+        # autoscale pillar knobs (docs/SCALING.md "Scale-out tier")
+        self._as_on = bool(getattr(m, "autoscale", False)
+                           if m is not None else False)
+        self._as_min = int(getattr(m, "autoscale_min_workers", 1)
+                           if m is not None else 1)
+        self._as_max = int(getattr(m, "autoscale_max_workers", 4)
+                           if m is not None else 4)
+        self._as_up_queue = float(
+            getattr(m, "autoscale_up_queue_p99_ms", 50.0)
+            if m is not None else 50.0)
+        self._as_up_shed = float(
+            getattr(m, "autoscale_up_shed_rate", 0.5)
+            if m is not None else 0.5)
+        self._as_down_queue = float(
+            getattr(m, "autoscale_down_queue_p99_ms", 5.0)
+            if m is not None else 5.0)
+        self._as_cooldown_s = float(
+            getattr(m, "autoscale_cooldown_s", 30.0)
+            if m is not None else 30.0)
+        # scaling acts only through operator-attached hooks; without
+        # them the pillar still evaluates and emits events (the policy
+        # is observable before it is trusted). All three are touched
+        # only under the mutation lock (the pillar job) or before
+        # start() — attach_scaler is a wiring call, not a hot path.
+        self._spawn_hook: Optional[Callable[[int], None]] = None
+        self._drain_hook: Optional[Callable[[int], None]] = None
+        self._size_hook: Optional[Callable[[], int]] = None
+        self._last_scale_t: Optional[float] = None
+        # injectable for the fake-clock pillar-ladder tests
+        self._clock: Callable[[], float] = time.monotonic
         self._lock = threading.Lock()
         # one mutation at a time across pillars AND run_once (re-entrant:
         # run_once drives all three jobs under one hold). The mutation
@@ -108,12 +145,26 @@ class MaintenanceService:
             return self
         for name, job in (("compaction", self._compact_once),
                           ("rebuild", self._rebuild_once),
-                          ("janitor", self._janitor_once)):
+                          ("janitor", self._janitor_once),
+                          ("autoscale", self._autoscale_once)):
             t = threading.Thread(target=self._run_worker, args=(name, job),
                                  daemon=True, name=f"maint-{name}")
             self._threads.append(t)
             t.start()
         return self
+
+    def attach_scaler(self, spawn: Callable[[int], None],
+                      drain: Callable[[int], None],
+                      size: Optional[Callable[[], int]] = None) -> None:
+        """Wire the autoscale pillar's actuators: `spawn(index)` starts
+        the worker for the next tail partition index, `drain(index)`
+        drains the highest one (the membership-at-the-tail rule,
+        docs/SCALING.md), `size()` reports the current fleet size —
+        defaulting to the attached service's live-worker count. Call
+        before start(); without hooks the pillar only observes."""
+        self._spawn_hook = spawn
+        self._drain_hook = drain
+        self._size_hook = size
 
     def _run_worker(self, name: str, job: Callable[[], Optional[Dict]]
                     ) -> None:
@@ -183,7 +234,8 @@ class MaintenanceService:
         with self._mlock:
             for name, job in (("janitor", self._janitor_once),
                               ("compaction", self._compact_once),
-                              ("rebuild", self._rebuild_once)):
+                              ("rebuild", self._rebuild_once),
+                              ("autoscale", self._autoscale_once)):
                 res = self._guarded_job(name, job)
                 if res is not None:
                     out[name] = res
@@ -347,6 +399,71 @@ class MaintenanceService:
         self.registry.event("index_rebuild_bg", rb)
         faults.count("index_bg_rebuilds")
         return rb
+
+    # -- pillar: autoscale (docs/SCALING.md "Scale-out tier") --------------
+    def _autoscale_once(self) -> Optional[Dict]:
+        """One policy evaluation: read the windowed pressure signals off
+        the attached service, ladder them against the thresholds, and —
+        inside the fleet-size bounds, outside the cooldown — act through
+        the attached hooks. Spawn targets the next tail partition index,
+        drain the highest (membership changes at the TAIL, so the
+        gateway's contiguity rule re-cuts the split); both emit their
+        event whether or not a hook is attached."""
+        if not self._as_on:
+            return None
+        svc = self._svc
+        if svc is None:
+            return None
+        sig = svc.autoscale_signals()
+        reg = self.registry
+        reg.gauge("maintenance.autoscale_queue_p99_ms").set(
+            sig["queue_wait_p99_ms"])
+        reg.gauge("maintenance.autoscale_shed_rate").set(sig["shed_rate"])
+        if self._size_hook is not None:
+            size = int(self._size_hook())
+        elif getattr(svc, "_fanout", None) is not None:
+            size = len(svc._fanout.live_workers())
+        else:
+            return None       # no fleet to size
+        # the queue-p99 trigger needs a populated window (the same >= 4
+        # floor the admission door uses before trusting the percentile);
+        # the shed-rate trigger is already evidence by itself
+        queue_hot = (sig["queue_wait_samples"] >= 4
+                     and sig["queue_wait_p99_ms"] >= self._as_up_queue)
+        shed_hot = sig["shed_rate"] >= self._as_up_shed
+        calm = (sig["queue_wait_p99_ms"] <= self._as_down_queue
+                and sig["shed_rate"] == 0.0)
+        decision = None
+        if (queue_hot or shed_hot) and size < self._as_max:
+            decision = "up"
+        elif calm and size > self._as_min:
+            decision = "down"
+        if decision is None:
+            return None
+        now = self._clock()
+        if (self._last_scale_t is not None
+                and now - self._last_scale_t < self._as_cooldown_s):
+            return None       # cooling down: the last resize must settle
+        attrs = {"workers": size,
+                 "queue_wait_p99_ms": sig["queue_wait_p99_ms"],
+                 "shed_rate": sig["shed_rate"]}
+        if decision == "up":
+            acted = self._spawn_hook is not None
+            if acted:
+                self._spawn_hook(size)        # the next tail index
+            reg.event("autoscale_up", dict(
+                attrs, to_workers=size + 1, acted=acted,
+                trigger="queue_wait" if queue_hot else "shed_rate"))
+        else:
+            acted = self._drain_hook is not None
+            if acted:
+                self._drain_hook(size - 1)    # the highest index drains
+            reg.event("autoscale_down", dict(
+                attrs, to_workers=size - 1, acted=acted))
+        reg.counter("maintenance.autoscale_decisions").inc()
+        self._last_scale_t = now
+        return {"decision": decision, "workers": size, "acted": acted,
+                **{k: sig[k] for k in ("queue_wait_p99_ms", "shed_rate")}}
 
     # -- pillar: janitor ---------------------------------------------------
     def _janitor_once(self) -> Optional[Dict]:

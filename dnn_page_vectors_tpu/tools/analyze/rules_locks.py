@@ -58,7 +58,8 @@ class LockDisciplineRule(Rule):
              f"{PKG_NAME}/infer/partition_host.py",
              f"{PKG_NAME}/utils/telemetry.py",
              f"{PKG_NAME}/utils/faults.py",   # CircuitBreaker state
-             f"{PKG_NAME}/updates/append.py", f"{PKG_NAME}/maintenance/")
+             f"{PKG_NAME}/updates/append.py", f"{PKG_NAME}/maintenance/",
+             f"{PKG_NAME}/loadgen/driver.py")  # BalancedClient counters
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ctx.tree.body:

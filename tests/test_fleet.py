@@ -1,0 +1,511 @@
+"""Scale-out front-end tier + elastic worker fleet (docs/SCALING.md
+"Scale-out tier"): N front ends over ONE shared worker set must stay
+byte-identical to the single-front-end oracle at every topology; a
+worker JOINING re-splits the partition map live through the
+generation-gated REFRESH handoff and a DRAINING worker hands its slice
+back — with the PR-14 pin extended: no result set ever mixes partition
+splits (any mixed-split merge breaks byte identity and fails here); the
+autoscale pillar ladders windowed queue-wait/shed pressure into
+spawn/drain decisions on a fake clock; and kill -9 of one front end
+leaves the other serving (front ends share workers, not fate)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+
+pytestmark = pytest.mark.fleet
+
+DIM = 32
+SHARD = 50
+NSHARDS = 6
+
+
+# ---------------------------------------------------------------------------
+# fixtures: synthetic store + model-free services (the test_net.py idiom)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def net_store(tmp_path_factory):
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    sdir = str(tmp_path_factory.mktemp("fleet_store") / "store")
+    rng = np.random.default_rng(0)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    for si in range(NSHARDS):
+        v = rng.standard_normal((SHARD, DIM)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(si * SHARD, (si + 1) * SHARD,
+                                        dtype=np.int64), v)
+    return VectorStore(sdir)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _qv(n=3, seed=1):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((n, DIM)).astype(np.float32)
+    return q / np.linalg.norm(q, axis=1, keepdims=True)
+
+
+def _service(net_store, mesh, **serve_over):
+    import dataclasses
+
+    from dnn_page_vectors_tpu.infer.partition_host import MeshEmbedder
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM})
+    if serve_over:
+        cfg = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    **serve_over))
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, net_store,
+                        preload_hbm_gb=4.0)
+    return svc
+
+
+def _fleet_worker(cfg, store_dir, ports, partition, partitions, replica,
+                  mesh):
+    """One in-thread worker registered with EVERY listed gateway port
+    (the multi-front-end link fan-out)."""
+    from dnn_page_vectors_tpu.infer.partition_host import PartitionWorker
+    w = PartitionWorker(cfg, store_dir, [("127.0.0.1", p) for p in ports],
+                        partition=partition, partitions=partitions,
+                        replica=replica, mesh=mesh)
+    t = threading.Thread(target=w.run, daemon=True)
+    t.start()
+    return w, t
+
+
+# ---------------------------------------------------------------------------
+# multi-gateway byte identity: 2 front ends x (P=2, R=2), one worker set
+# ---------------------------------------------------------------------------
+
+def test_two_front_ends_byte_identical_p2_r2(net_store, mesh):
+    """Both front ends must answer byte-identically to the
+    single-front-end in-process oracle captured BEFORE any gateway
+    attached — the shared fleet serves N gateways as one worker set."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    over = dict(partitions=2, replicas=2, heartbeat_s=0.5)
+    svc0 = _service(net_store, mesh, **over)
+    qvs = _qv(8, seed=7)
+    oracle = [svc0.topk_vectors(qvs[i:i + 1], k=10) for i in range(8)]
+    svc1 = _service(net_store, mesh, **over)
+    gw0 = WorkerGateway(svc0, heartbeat_s=0.5)
+    svc0.attach_gateway(gw0)
+    gw1 = WorkerGateway(svc1, heartbeat_s=0.5)
+    svc1.attach_gateway(gw1)
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM,
+                                   "serve.partitions": 2,
+                                   "serve.replicas": 2})
+    workers = []
+    try:
+        for p in range(2):
+            for r in range(2):
+                w, _ = _fleet_worker(cfg, net_store.directory,
+                                     [gw0.port, gw1.port], p, 2, r, mesh)
+                workers.append(w)
+        assert gw0.wait_for_workers(4, timeout_s=60.0)
+        assert gw1.wait_for_workers(4, timeout_s=60.0)
+        for i in range(8):
+            for svc in (svc0, svc1):
+                s, ids = svc.topk_vectors(qvs[i:i + 1], k=10)
+                assert np.array_equal(s, oracle[i][0])
+                assert np.array_equal(ids, oracle[i][1])
+        # every worker holds one live session PER gateway
+        for w in workers:
+            assert w.sessions == 2
+        assert len(gw0.live_workers()) == 4
+        assert len(gw1.live_workers()) == 4
+    finally:
+        for w in workers:
+            w.stop()
+        gw0.close()
+        gw1.close()
+        svc0.close()
+        svc1.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic membership: join -> re-split -> drain, under a concurrent hammer
+# ---------------------------------------------------------------------------
+
+def test_join_resplit_drain_under_hammer(net_store, mesh):
+    """A worker joins mid-hammer (deterministic re-split to width 2),
+    then drains back out (re-split to width 1) — through both handoffs
+    every answer stays byte-identical to the pre-attach oracle. A
+    mixed-split result set would merge two different partition cuts and
+    break identity, so zero mismatches IS the zero-mixed-splits pin."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=1, replicas=1,
+                   elastic=True, heartbeat_s=0.25)
+    qvs = _qv(6, seed=3)
+    oracle = [svc.topk_vectors(qvs[i:i + 1], k=10) for i in range(6)]
+    gw = WorkerGateway(svc, heartbeat_s=0.25)
+    svc.attach_gateway(gw)
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM,
+                                   "serve.heartbeat_s": 0.25})
+    w0, _ = _fleet_worker(cfg, net_store.directory, [gw.port], 0, 1, 0,
+                          mesh)
+    assert gw.wait_for_workers(1, timeout_s=60.0)
+    stop = threading.Event()
+    errors = []
+    mismatches = []
+
+    def _hammer():
+        i = 0
+        while not stop.is_set():
+            qi = i % 6
+            i += 1
+            try:
+                s, ids = svc.topk_vectors(qvs[qi:qi + 1], k=10)
+            except Exception as e:  # noqa: BLE001 — the pin is zero
+                errors.append(repr(e))
+                continue
+            if not (np.array_equal(s, oracle[qi][0])
+                    and np.array_equal(ids, oracle[qi][1])):
+                mismatches.append(qi)
+
+    threads = [threading.Thread(target=_hammer, daemon=True)
+               for _ in range(2)]
+    w1 = None
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        # JOIN: the tail index appears -> width 2 re-split
+        w1, _ = _fleet_worker(cfg, net_store.directory, [gw.port], 1, 2,
+                              0, mesh)
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            table = gw.partition_set._view_table
+            if (len(table) == 2 and len(gw.live_workers()) == 2
+                    and gw.stale_workers(table[0][0].generation,
+                                         split=2) == 0):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("join re-split never completed")
+        time.sleep(0.5)                      # hammer ON the new split
+        # DRAIN: the tail worker hands its slice back -> width 1
+        threading.Thread(target=w1.drain, kwargs={"wait_s": 0.3},
+                         daemon=True).start()
+        deadline = time.perf_counter() + 15.0
+        while time.perf_counter() < deadline:
+            if len(gw.partition_set._view_table) == 1:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("drain re-split never completed")
+        time.sleep(0.3)                      # hammer past the handoff
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if w1 is not None:
+            w1.stop()
+        w0.stop()
+        gw.close()
+        svc.close()
+    assert errors == []
+    assert mismatches == []                  # zero mixed-split sets
+    triggers = [e["attrs"]["trigger"]
+                for e in svc.registry.events("fleet_resplit")]
+    assert "join" in triggers and "drain" in triggers
+    assert svc.registry.events("worker_draining")
+    assert gw.stats()["resplits"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# autoscale pillar: the policy ladder on a fake clock
+# ---------------------------------------------------------------------------
+
+class _SvcStub:
+    """A service exposing only what the pillar reads."""
+
+    def __init__(self):
+        self.sig = {"queue_wait_p99_ms": 0.0, "queue_wait_samples": 0.0,
+                    "shed_rate": 0.0, "window_s": 10.0}
+
+    def autoscale_signals(self):
+        return dict(self.sig)
+
+
+def _scaler(tmp_path, over):
+    from dnn_page_vectors_tpu.maintenance.service import MaintenanceService
+    from dnn_page_vectors_tpu.utils.telemetry import MetricsRegistry
+    cfg = get_config("cdssm_toy", {"maintenance.autoscale": True,
+                                   "maintenance.autoscale_min_workers": 1,
+                                   "maintenance.autoscale_max_workers": 3,
+                                   "maintenance.autoscale_cooldown_s":
+                                       30.0, **over})
+    stub = _SvcStub()
+    ms = MaintenanceService(cfg, str(tmp_path), None, svc=stub,
+                            registry=MetricsRegistry())
+    clock = [1000.0]
+    ms._clock = lambda: clock[0]
+    size = [1]
+    spawned, drained = [], []
+
+    def _spawn(i):
+        spawned.append(i)
+        size[0] += 1
+
+    def _drain(i):
+        drained.append(i)
+        size[0] -= 1
+
+    ms.attach_scaler(_spawn, _drain, size=lambda: size[0])
+    return ms, stub, clock, size, spawned, drained
+
+
+def test_autoscale_ladder_on_fake_clock(tmp_path):
+    """Up on queue-wait pressure, up on shed rate, bounded by max,
+    cooled down between actions, down when calm, bounded by min —
+    spawn targets the next TAIL index, drain the highest."""
+    ms, stub, clock, size, spawned, drained = _scaler(tmp_path, {})
+    hot = {"queue_wait_p99_ms": 120.0, "queue_wait_samples": 16.0,
+           "shed_rate": 0.0, "window_s": 10.0}
+    calm = {"queue_wait_p99_ms": 1.0, "queue_wait_samples": 16.0,
+            "shed_rate": 0.0, "window_s": 10.0}
+    stub.sig = hot
+    out = ms._autoscale_once()
+    assert out["decision"] == "up" and spawned == [1] and size[0] == 2
+    # inside the cooldown: pressure persists but NO second action
+    assert ms._autoscale_once() is None and spawned == [1]
+    clock[0] += 31.0
+    assert ms._autoscale_once()["decision"] == "up"
+    assert spawned == [1, 2] and size[0] == 3
+    # at max: no up decision even under pressure
+    clock[0] += 31.0
+    assert ms._autoscale_once() is None
+    # calm: drain the highest index, one cooldown apart
+    stub.sig = calm
+    assert ms._autoscale_once()["decision"] == "down"
+    assert drained == [2] and size[0] == 2
+    assert ms._autoscale_once() is None          # cooling down
+    clock[0] += 31.0
+    assert ms._autoscale_once()["decision"] == "down"
+    assert drained == [2, 1] and size[0] == 1
+    # at min: calm no longer drains
+    clock[0] += 31.0
+    assert ms._autoscale_once() is None
+    ups = ms.registry.events("autoscale_up")
+    downs = ms.registry.events("autoscale_down")
+    assert len(ups) == 2 and len(downs) == 2
+    assert all(e["attrs"]["acted"] for e in ups + downs)
+    assert ups[0]["attrs"]["trigger"] == "queue_wait"
+
+
+def test_autoscale_shed_trigger_and_sample_floor(tmp_path):
+    ms, stub, clock, size, spawned, drained = _scaler(tmp_path, {})
+    # a hot percentile off a near-empty window is noise, not pressure
+    stub.sig = {"queue_wait_p99_ms": 500.0, "queue_wait_samples": 3.0,
+                "shed_rate": 0.0, "window_s": 10.0}
+    assert ms._autoscale_once() is None
+    # the shed rate is evidence by itself (every shed was a real miss)
+    stub.sig = {"queue_wait_p99_ms": 0.0, "queue_wait_samples": 0.0,
+                "shed_rate": 0.9, "window_s": 10.0}
+    out = ms._autoscale_once()
+    assert out["decision"] == "up" and spawned == [1]
+    ev = ms.registry.events("autoscale_up")
+    assert ev[-1]["attrs"]["trigger"] == "shed_rate"
+
+
+def test_autoscale_off_is_inert(tmp_path):
+    from dnn_page_vectors_tpu.maintenance.service import MaintenanceService
+    from dnn_page_vectors_tpu.utils.telemetry import MetricsRegistry
+    cfg = get_config("cdssm_toy")
+    assert cfg.maintenance.autoscale is False
+    stub = _SvcStub()
+    stub.sig["shed_rate"] = 1.0
+    ms = MaintenanceService(cfg, str(tmp_path), None, svc=stub,
+                            registry=MetricsRegistry())
+    assert ms._autoscale_once() is None
+    assert ms.registry.events("autoscale_up") == []
+
+
+# ---------------------------------------------------------------------------
+# wait barriers report why they timed out (stats + event, not a bare False)
+# ---------------------------------------------------------------------------
+
+def test_wait_for_workers_timeout_reports_state(net_store, mesh):
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    svc = _service(net_store, mesh, partitions=1, replicas=1)
+    gw = WorkerGateway(svc, heartbeat_s=0.5)
+    svc.attach_gateway(gw)
+    try:
+        t0 = time.perf_counter()
+        assert gw.wait_for_workers(1, timeout_s=0.3) is False
+        assert time.perf_counter() - t0 >= 0.3
+        ev = svc.registry.events("gateway_wait_timeout")
+        assert len(ev) == 1
+        attrs = ev[0]["attrs"]
+        assert attrs["barrier"] == "workers"
+        assert attrs["waited_s"] >= 0.3 and attrs["wanted"] == 1
+        assert attrs["live"] == 0
+        assert gw.stats()["wait_timeouts"] == 1
+    finally:
+        gw.close()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# one front end dies (kill -9); the other keeps serving the shared fleet
+# ---------------------------------------------------------------------------
+
+_FE_SCRIPT = """
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+from jax.sharding import Mesh
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.infer.partition_host import (MeshEmbedder,
+                                                       WorkerGateway)
+from dnn_page_vectors_tpu.infer.serve import SearchService
+from dnn_page_vectors_tpu.infer.server import serve_in_background
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+store = VectorStore(sys.argv[1])
+mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+cfg = get_config("cdssm_toy", {"model.out_dim": int(sys.argv[2]),
+                               "serve.partitions": 1,
+                               "serve.replicas": 1})
+svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                    preload_hbm_gb=4.0)
+gw = WorkerGateway(svc, heartbeat_s=0.5)
+svc.attach_gateway(gw)
+srv = serve_in_background(svc, front_end=1)
+print(json.dumps({"gw_port": gw.port, "srv_port": srv.port}), flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+@pytest.mark.slow
+def test_kill_9_one_front_end_other_keeps_serving(net_store, mesh,
+                                                  tmp_path):
+    """Two front ends share one worker; SIGKILL the second front end's
+    whole process mid-serve. The worker's link to the dead gateway goes
+    into its reconnect loop, the surviving front end keeps answering
+    byte-identically — front ends share the fleet, not fate."""
+    from dnn_page_vectors_tpu.infer.partition_host import WorkerGateway
+    from dnn_page_vectors_tpu.infer.transport import SocketSearchClient
+    svc0 = _service(net_store, mesh, partitions=1, replicas=1,
+                    heartbeat_s=0.5)
+    qvs = _qv(4, seed=11)
+    oracle = [svc0.topk_vectors(qvs[i:i + 1], k=10) for i in range(4)]
+    gw0 = WorkerGateway(svc0, heartbeat_s=0.5)
+    svc0.attach_gateway(gw0)
+    script = tmp_path / "fe.py"
+    script.write_text(_FE_SCRIPT)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # a script path puts ITS directory on sys.path, not the cwd — the
+    # package only resolves through PYTHONPATH
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.Popen(
+        [sys.executable, str(script), net_store.directory, str(DIM)],
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    w = None
+    client = None
+    try:
+        ready = json.loads(proc.stdout.readline())
+        cfg = get_config("cdssm_toy", {"model.out_dim": DIM,
+                                       "serve.heartbeat_s": 0.5})
+        w, _ = _fleet_worker(cfg, net_store.directory,
+                             [gw0.port, ready["gw_port"]], 0, 1, 0, mesh)
+        assert gw0.wait_for_workers(1, timeout_s=60.0)
+        # the second front end serves the shared worker over its socket
+        client = SocketSearchClient("127.0.0.1", ready["srv_port"])
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            s, ids, _scan = client.topk_vectors(qvs[0:1], k=10)
+            if np.array_equal(s, oracle[0][0]):
+                break
+            time.sleep(0.1)
+        assert np.array_equal(ids, oracle[0][1])
+        client.close()
+        client = None
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        # the survivor serves on, byte-identical, across heartbeats
+        t_end = time.perf_counter() + 2.0
+        n = 0
+        while time.perf_counter() < t_end:
+            qi = n % 4
+            s, ids = svc0.topk_vectors(qvs[qi:qi + 1], k=10)
+            assert np.array_equal(s, oracle[qi][0])
+            assert np.array_equal(ids, oracle[qi][1])
+            n += 1
+        assert n > 0
+        assert gw0.worker_alive(0, 0)
+    finally:
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.kill()
+        if w is not None:
+            w.stop()
+        gw0.close()
+        svc0.close()
+
+
+# ---------------------------------------------------------------------------
+# the client-side balancer (loadgen/driver.py BalancedClient)
+# ---------------------------------------------------------------------------
+
+class _CountClient:
+    def __init__(self):
+        self.calls = 0
+
+    def search(self, query, k=10, nprobe=None):
+        self.calls += 1
+        return query
+
+
+class _BoomClient:
+    def search(self, query, k=10, nprobe=None):
+        raise RuntimeError("down")
+
+
+def test_balanced_client_round_robin_is_seeded():
+    from dnn_page_vectors_tpu.loadgen import BalancedClient
+    cs = [_CountClient() for _ in range(3)]
+    bc = BalancedClient(cs, policy="round_robin", seed=1)
+    for _ in range(6):
+        bc.search("q")
+    assert [c.calls for c in cs] == [2, 2, 2]
+    # the seed sets the rotation phase: seed=1 starts at client 1
+    cs2 = [_CountClient() for _ in range(3)]
+    BalancedClient(cs2, policy="round_robin", seed=1).search("q")
+    assert [c.calls for c in cs2] == [0, 1, 0]
+    assert bc.stats()["sent"] == [2, 2, 2]
+
+
+def test_balanced_client_least_loaded_and_errors():
+    from dnn_page_vectors_tpu.loadgen import BalancedClient
+    cs = [_CountClient(), _CountClient()]
+    bc = BalancedClient(cs, policy="least_loaded", seed=0)
+    for _ in range(4):
+        bc.search("q")
+    # nothing in flight between synchronous calls: least-loaded
+    # degenerates to the seeded rotation — deterministic spread
+    assert [c.calls for c in cs] == [2, 2]
+    bc2 = BalancedClient([_BoomClient()], policy="round_robin")
+    with pytest.raises(RuntimeError):
+        bc2.search("x")
+    assert bc2.stats()["errors"] == [1]
+    with pytest.raises(ValueError):
+        BalancedClient(cs, policy="nope")
+    with pytest.raises(ValueError):
+        BalancedClient([], policy="round_robin")

@@ -141,7 +141,9 @@ def test_frame_codec_roundtrip():
     assert transport.decode_register(
         transport._REGISTER_HEAD.pack(3, 0, 42)) == (3, 0, 42, 0, 0)
     assert transport.decode_hello(transport.encode_hello(1)) == 1
-    assert transport.decode_refresh(transport.encode_refresh(9)) == 9
+    assert transport.decode_refresh(transport.encode_refresh(9)) == (9, 0)
+    assert transport.decode_refresh(
+        transport.encode_refresh(9, partitions=3)) == (9, 3)
 
 
 def test_frame_fuzz_truncation_garbage_oversize():
@@ -624,20 +626,25 @@ def test_hedge_fires_to_sibling_after_quantile(net_store, mesh):
         for _ in range(10):                   # warm the latency history
             s, i = svc.topk_vectors(qv, k=10)
             assert np.array_equal(i, base_i)
-        assert svc.hedge_fires == 0
+        # at quantile 0.5 the hedge delay sits within scheduler noise of
+        # the healthy ~2 ms latency on a loaded 1-core box, so a warm-up
+        # query may legitimately hedge; the pin is that the DELIBERATELY
+        # slow primary below adds exactly one more, not that noise never
+        # trips the quantile
+        warm_hedges = svc.hedge_fires
         assert gw._hedge_delay_s(0) is not None
         workers[0][0].slow_ms = 300.0         # the primary turns slow
         t0 = time.perf_counter()
         s, i = svc.topk_vectors(qv, k=10)
         dt = time.perf_counter() - t0
         assert np.array_equal(s, base_s) and np.array_equal(i, base_i)
-        assert svc.hedge_fires == 1
+        assert svc.hedge_fires == warm_hedges + 1
         assert dt < 0.28, f"hedge did not save the call ({dt * 1e3:.0f} ms)"
         evs = [e for e in svc.registry.events()
                if e["event"] == "hedge_fired"]
         assert evs and evs[-1]["attrs"]["partition"] == 0
         assert evs[-1]["attrs"]["to_replica"] == 1
-        assert svc.metrics()["transport"]["hedge_fires"] == 1
+        assert svc.metrics()["transport"]["hedge_fires"] == warm_hedges + 1
     finally:
         for w, _ in workers:
             w.stop()
