@@ -132,7 +132,13 @@ _GATE_SKIP = {"vs_baseline", "attempts", "slo_p99_target_ms",
               # _off, cache_serve_speedup, cache_hit_rate higher-is-
               # better; cache_serve_us_per_hit lower-is-better) all gate
               "cache_store_rows", "cache_dim", "cache_k",
-              "cache_distinct", "cache_entries"}
+              "cache_distinct", "cache_entries",
+              # migration drill protocol constants (unit count tracks
+              # store geometry, the stamp is a counter) — the MEASURED
+              # keys (migrate_pages_per_s higher-is-better;
+              # migration_sweep_seconds, migration_swap_ms,
+              # serve_p99_during_migration_ms lower-is-better) all gate
+              "migration_units", "post_migration_model_step"}
 _LOWER_IS_BETTER = ("_ms", "seconds", "imbalance", "error", "_bytes",
                     "lint_", "shed", "hedge", "_us_per_", "dip")
 
@@ -895,6 +901,92 @@ def run_worker() -> None:
                                 f"{mlat.percentile_ms(99):.1f} ms")
                         except Exception as e:  # keep serve + ann data
                             rec["maintenance_error"] = \
+                                f"{type(e).__name__}: {e}"[:300]
+
+                    # ---- migration sub-phase: rolling re-embed under
+                    # load (docs/MAINTENANCE.md "Rolling model
+                    # migration"): the migrate pillar sweeps the live
+                    # serve store to a new model stamp unit-by-unit —
+                    # every flip hot-swapped into the service, queries
+                    # running dual-stamp mid-sweep — while 4 query
+                    # threads hammer it. Measured: re-embed throughput,
+                    # the sweep's wall clock, and serve p99 WHILE the
+                    # store flipped stamps. The target params are the
+                    # same trained tower (the drill prices the sweep
+                    # machinery, not a second training run), so results
+                    # stay comparable across rounds. BENCH_MIGRATE=0
+                    # skips.
+                    if os.environ.get("BENCH_MIGRATE", "1") != "0":
+                        try:
+                            import threading as _threading
+                            _stamp("migration phase: rolling re-embed "
+                                   "under query load")
+                            # fresh handle: the compaction sub-phase may
+                            # have purged files sstore still references
+                            gstore = VectorStore(sstore.directory)
+                            gsvc = SearchService(acfg, embedder,
+                                                 trainer.corpus, gstore,
+                                                 preload_hbm_gb=4.0)
+                            gsvc.warmup(k=kq)
+                            gmaint = gsvc.start_maintenance(threads=False)
+                            g_to = int(gstore.model_step) + 1
+                            gmaint.request_migration(g_to, trainer.corpus,
+                                                     embedder)
+                            glat = LatencyStats()
+                            gstop = _threading.Event()
+
+                            def _ghammer(wid):
+                                i = wid
+                                while not gstop.is_set():
+                                    with glat.timed():
+                                        gsvc.search(qtexts[i % distinct],
+                                                    k=kq)
+                                    i += 1
+
+                            gthreads = [
+                                _threading.Thread(target=_ghammer,
+                                                  args=(w,), daemon=True)
+                                for w in range(4)]
+                            for t in gthreads:
+                                t.start()
+                            gt0 = time.perf_counter()
+                            g_units, g_rows, g_swaps = 0, 0, []
+                            while True:
+                                gout = gmaint.run_once().get("migrate")
+                                if gout is None:
+                                    break
+                                if gout.get("refresh_swap_ms") is not None:
+                                    g_swaps.append(gout["refresh_swap_ms"])
+                                if gout.get("action") == "migrating":
+                                    g_units += len(gout.get("units") or [])
+                                    g_rows += int(gout.get("rows", 0))
+                                else:
+                                    break
+                            g_dt = time.perf_counter() - gt0
+                            gstop.set()
+                            for t in gthreads:
+                                t.join()
+                            gsvc.close()
+                            rec.update({
+                                "migration_units": g_units,
+                                "migrate_pages_per_s": round(
+                                    g_rows / max(g_dt, 1e-9), 2),
+                                "migration_sweep_seconds": round(g_dt, 3),
+                                "migration_swap_ms": (round(
+                                    max(g_swaps), 3) if g_swaps else None),
+                                "serve_p99_during_migration_ms": round(
+                                    glat.percentile_ms(99), 3),
+                                "post_migration_model_step":
+                                    VectorStore(sstore.directory,
+                                                verify=False).model_step,
+                            })
+                            _stamp(
+                                f"migration phase done: {g_units} units "
+                                f"({g_rows} rows) in {g_dt:.1f}s, p99 "
+                                f"under migration "
+                                f"{glat.percentile_ms(99):.1f} ms")
+                        except Exception as e:  # keep serve + ann data
+                            rec["migration_error"] = \
                                 f"{type(e).__name__}: {e}"[:300]
                 except Exception as e:  # ann failure must keep serve data
                     rec["ann_error"] = f"{type(e).__name__}: {e}"[:300]

@@ -13,6 +13,7 @@
   python -m dnn_page_vectors_tpu.cli append --config cdssm_toy \
       --set data.num_pages=12000 --tombstone 17,42
   python -m dnn_page_vectors_tpu.cli refresh --config cdssm_toy
+  python -m dnn_page_vectors_tpu.cli migrate --config cdssm_toy
   python -m dnn_page_vectors_tpu.cli maintain --config cdssm_toy --once
   python -m dnn_page_vectors_tpu.cli trace --config cdssm_toy --query "..."
   python -m dnn_page_vectors_tpu.cli serve-metrics --config cdssm_toy
@@ -119,7 +120,8 @@ def main(argv=None) -> None:
                                         "search", "pipeline", "configs",
                                         "init-store", "merge-store",
                                         "reset-store", "index", "append",
-                                        "refresh", "maintain", "trace",
+                                        "migrate", "refresh", "maintain",
+                                        "trace",
                                         "serve-metrics", "loadtest",
                                         "partition-worker", "lint"])
     ap.add_argument("--once", action="store_true",
@@ -632,6 +634,35 @@ def main(argv=None) -> None:
     pi, pc = process_info()
     model_step = int(state.step)
     fleet = args.start != 0 or args.stop is not None
+
+    if args.command == "migrate":
+        # Rolling model migration (docs/MAINTENANCE.md "Rolling model
+        # migration"): re-embed the EXISTING store to this checkpoint's
+        # model step unit-by-unit — base shard table first, then each
+        # appended generation — every unit committed with one atomic
+        # manifest flip. The store stays serveable the whole sweep: a
+        # SearchService over it serves dual-stamp mid-sweep and picks
+        # each flip up on its next refresh(). Contrast `embed`, which
+        # RESETS a stale-stamped store and starts over.
+        from dnn_page_vectors_tpu.maintenance import (
+            migrate_store, purge_stale)
+        try:
+            store = VectorStore(store_dir)
+        except FileNotFoundError:
+            raise SystemExit(f"no store at {store_dir}; run 'embed' "
+                             "before migrating")
+        out = migrate_store(store, trainer.corpus, embedder, model_step,
+                            batch_rows=cfg.migrate.batch_rows)
+        purged = {}
+        if cfg.migrate.purge and out.get("action") == "migrated":
+            purged = purge_stale(store, out)
+        print(json.dumps({
+            "store": store_dir,
+            **{k: v for k, v in out.items()
+               if k not in ("stale_files", "stale_dirs")},
+            **purged, "store_generation": store.generation,
+            "fault_counters": faults.counters()}, sort_keys=True))
+        return
 
     if args.command == "embed":
         # vectors from an older checkpoint are stale, not resumable work: a

@@ -430,6 +430,29 @@ class MaintenanceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class MigrationConfig:
+    """Rolling model migration (dnn_page_vectors_tpu/maintenance/migrate.py,
+    docs/MAINTENANCE.md "Rolling model migration"): re-embed a LIVE store
+    to a new model step unit-by-unit while serving runs dual-stamp. The
+    sweep itself is requested at runtime (`cli migrate`, or
+    MaintenanceService.request_migration); these knobs shape how it
+    runs."""
+    # Host-side text rows per embed call while re-embedding a shard: the
+    # memory/throughput trade of the sweep's bulk encode (same role as the
+    # embed pipeline's batch, but off-path — it never blocks a query).
+    batch_rows: int = 4096
+    # Units the migrate pillar commits per maintenance pass before
+    # hot-swapping the serving view. 1 keeps each refresh window small
+    # (one unit's shards restage); raise it to trade refresh frequency
+    # for sweep speed on large chains.
+    units_per_pass: int = 1
+    # Reclaim each unit's superseded shard files right after the serving
+    # view moves past them (purge_stale). False leaves the bytes for the
+    # janitor — the forensic setting.
+    purge: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Observability (utils/telemetry.py, utils/tracing.py,
     docs/OBSERVABILITY.md): request-scoped tracing, the slow-query log,
@@ -488,6 +511,8 @@ class Config:
     updates: UpdatesConfig = dataclasses.field(default_factory=UpdatesConfig)
     maintenance: MaintenanceConfig = dataclasses.field(
         default_factory=MaintenanceConfig)
+    migrate: MigrationConfig = dataclasses.field(
+        default_factory=MigrationConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     workdir: str = "/tmp/dnn_page_vectors_tpu"

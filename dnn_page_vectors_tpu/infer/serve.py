@@ -357,6 +357,19 @@ class AdaptiveWindow:
         return new
 
 
+def _merge_topk_host(s1, i1, s2, i2, k: int):
+    """Fold two [n, k] (scores fp32, page_ids int64) candidate sets into
+    one top-k on host — the cross-stamp merge for the streaming dual-stamp
+    path (docs/MAINTENANCE.md "Rolling model migration"); the resident
+    path merges all stamps on device through the view's packed program.
+    Stable on ties (first set wins), -inf/-1 padding sorts last."""
+    s = np.concatenate([s1, s2], axis=1)
+    i = np.concatenate([i1, i2], axis=1)
+    order = np.argsort(-s, axis=1, kind="stable")[:, :k]
+    return (np.take_along_axis(s, order, axis=1),
+            np.take_along_axis(i, order, axis=1))
+
+
 class _ServeView:
     """One atomic serving snapshot (docs/UPDATES.md): everything
     search_many touches that a refresh() can change — the store handle
@@ -368,9 +381,10 @@ class _ServeView:
     the query path, no torn half-view ever observable."""
 
     __slots__ = ("store", "entries", "generation", "shards", "shard_keys",
-                 "stream_entries", "pid_table", "merge", "pad_rows",
-                 "index", "index_error", "index_info", "docs_appended",
-                 "tombstoned", "num_vectors", "maint_stats", "restricted")
+                 "shard_steps", "steps", "stream_entries", "pid_table",
+                 "merge", "pad_rows", "index", "index_error", "index_info",
+                 "docs_appended", "tombstoned", "num_vectors", "maint_stats",
+                 "restricted")
 
     def __init__(self, store: VectorStore,
                  entries: Optional[List[Dict]] = None):
@@ -389,8 +403,15 @@ class _ServeView:
         # the compaction trigger's inputs, frozen with the chain they
         # describe (docs/MAINTENANCE.md): density/dead-rows/reclaimable
         self.maint_stats: Dict = store.maintenance_stats()
+        # distinct model stamps over the FULL table, ascending — mid-
+        # migration (docs/MAINTENANCE.md "Rolling model migration") this is
+        # [from_step, to_step] and queries encode once per stamp; computed
+        # store-wide even for a restricted view so every partition splits a
+        # stacked query matrix on the same block order
+        self.steps: List[int] = store.model_steps()
         self.shards = None   # [(ids np[int64], n, pages [R, D], scl|None)]
         self.shard_keys: List[tuple] = []
+        self.shard_steps: List[Optional[int]] = []   # stamp per staged shard
         self.stream_entries: List[Dict] = []
         self.pid_table = None
         self.merge = None
@@ -411,6 +432,13 @@ class SearchService:
         self.embedder = embedder
         self.corpus = corpus
         self.store = store
+        # extra query towers keyed by model step (docs/MAINTENANCE.md
+        # "Rolling model migration"): begin_migration() attaches the target
+        # model's params here so mid-migration queries can encode with BOTH
+        # stamps; the refresh() that observes the completed stamp flip
+        # adopts the new tower into `embedder` and drops this reference.
+        # Whole-dict swap on write, snapshot read on the query path.
+        self._towers: Dict[int, object] = {}
         self.snippet_chars = snippet_chars
         self.degraded = False
         self.fault_counters: Dict[str, int] = {}
@@ -956,6 +984,19 @@ class SearchService:
                 self._view = view    # THE swap: one reference assignment
             self.store = new_store
             self._m_refreshes.inc()
+            # tower adoption (docs/MAINTENANCE.md "Rolling model
+            # migration"): once the store's migration record is gone the
+            # sweep either completed (stamp flipped — the target tower
+            # becomes THE query encoder) or was abandoned by a reset;
+            # either way the extra towers unload here, and the superseded
+            # params drop with this reference
+            adopted_step = None
+            tw = self._towers
+            if tw and new_store.migration is None:
+                if new_store.model_step in tw:
+                    self.embedder.params = tw[new_store.model_step]
+                    adopted_step = int(new_store.model_step)
+                self._towers = {}
         swap_ms = (time.perf_counter() - t_swap) * 1000.0
         info = {
             "store_generation": view.generation,
@@ -974,6 +1015,22 @@ class SearchService:
             info["index_update"] = view.index_info
         if view.index_error is not None:
             info["index_error"] = view.index_error
+        mig = view.store.migration
+        if mig is not None:
+            # migration progress rides every refresh log line while the
+            # sweep runs: which stamps this view serves, and how far the
+            # shard table has moved to the target
+            table = view.store.shards()
+            info["migration"] = {
+                "from_step": mig.get("from_step"),
+                "to_step": mig.get("to_step"),
+                "shards_migrated": sum(
+                    1 for e in table
+                    if view.store.entry_step(e) == mig.get("to_step")),
+                "shards_total": len(table),
+                "stamps_serving": list(view.steps)}
+        if adopted_step is not None:
+            info["migration_adopted_step"] = adopted_step
         if part_info is not None:
             # per-partition rolling-swap record (docs/SCALING.md): which
             # partition restaged when, and each replica's swap window
@@ -1024,6 +1081,19 @@ class SearchService:
             hot = idx.stage_hot(self._hot_gb * 2 ** 30)
         self.registry.event("hot_restaged", dict(hot))
         return hot
+
+    def begin_migration(self, params, step: int) -> None:
+        """Attach the TARGET model's params as a second query tower for a
+        rolling migration (docs/MAINTENANCE.md "Rolling model migration").
+        Until the completion flip, every search encodes with both towers
+        and each shard's scores come from the tower matching its recorded
+        stamp; the refresh() that observes the flipped store adopts this
+        tower and unloads the old one. Idempotent per step; whole-dict
+        swap, so the query path never sees a half-updated tower map."""
+        self._towers = {**self._towers, int(step): params}
+        if self._log is not None:
+            self._log.write({"serve_migration_tower": int(step),
+                             "serving_step": self.store.model_step})
 
     def _build_view(self, store: VectorStore, reuse: "_ServeView" = None,
                     update_index: bool = False,
@@ -1163,12 +1233,18 @@ class SearchService:
                   nprobe: Optional[int] = None):
         """ANN (scores [n, k], page_ids [n, k], scan_bytes) for `n` real
         queries, or None to fall back to the exact path (index missing,
-        stale against the view store's CURRENT model step, or failing at
-        search time — the failure quarantine already happened inside the
-        index layer). `nprobe` overrides the serve.nprobe default per
-        request (mixed-profile load tests)."""
+        stale against the view store's CURRENT model step, mid-migration
+        mixed stamps, or failing at search time — the failure quarantine
+        already happened inside the index layer). `nprobe` overrides the
+        serve.nprobe default per request (mixed-profile load tests)."""
         idx = view.index
         if idx is None or idx.model_step != view.store.model_step:
+            return None
+        if len(view.steps) > 1:
+            # mid-migration a single-stamp index would rank OLD-encoder
+            # centroids against new-encoder shards (or vice versa): the
+            # exact path routes per shard stamp instead, and the per-stamp
+            # rebuild swaps a matching index back in after completion
             return None
         nprobe = nprobe or self._nprobe
         # the index pads queries to a power-of-two bucket internally:
@@ -1223,12 +1299,16 @@ class SearchService:
                 and reuse.pad_rows == rows):
             reuse_map = {key: tup for key, tup
                          in zip(reuse.shard_keys, reuse.shards)}
-        staged, keys = [], []
+        staged, keys, stamps = [], [], []
         used = 0.0
         per_shard = rows * per_row / self._n_data
         for entry in view.entries:
             if entry["count"] == 0:   # zero-count shards hold nothing to score
                 continue
+            # one stamp per shard, never mixed within one (the migration
+            # pin, docs/MAINTENANCE.md): recorded here so _dispatch_bucket
+            # can score the shard with the matching tower's query block
+            estep = store.entry_step(entry)
             key = (entry.get("gen", 0), entry["index"], entry["count"],
                    entry.get("crc", {}).get("vec"))
             try:
@@ -1243,6 +1323,7 @@ class SearchService:
                         # by an earlier skip): plain reuse
                         staged.append((old_ids, old_n, pages, scl))
                         keys.append(key)
+                        stamps.append(estep)
                         used += per_shard
                         continue
                     # tombstone-aware restage policy (docs/UPDATES.md):
@@ -1259,6 +1340,7 @@ class SearchService:
                                           old_ids, np.int64(-1))
                         staged.append((masked, old_n, pages, scl))
                         keys.append(key)
+                        stamps.append(estep)
                         used += per_shard
                         self._m_restage_skipped.inc()
                         continue
@@ -1296,6 +1378,7 @@ class SearchService:
                                *stage_shard(vecs, rows, store.dim,
                                             self.embedder.mesh, scales=scl)))
                 keys.append(key)
+                stamps.append(estep)
                 used += per_shard
             except Exception as e:  # noqa: BLE001 — any staging failure
                 # (injected I/O fault, real device OOM, budget overrun)
@@ -1315,6 +1398,7 @@ class SearchService:
                     "streaming path (degraded)")
         view.shards = staged
         view.shard_keys = keys
+        view.shard_steps = stamps
         if not staged:
             return
         # combined-id -> page-id table for the device-side merge below:
@@ -1375,8 +1459,17 @@ class SearchService:
             result_n = len(self._rcache)
             self._rcache.clear()
             self._rcache_bytes = 0
-        self.registry.event("cache_cleared", {
-            "embed_entries": embed_n, "result_entries": result_n})
+        ev = {"embed_entries": embed_n, "result_entries": result_n}
+        mig = self.store.migration
+        if mig is not None:
+            # a flush mid-migration is worth flagging: entries keyed under
+            # the OLD stamp composition never come back after the flip, so
+            # repeated clears here usually mean a misdriven sweep
+            ev["migration"] = (f"{mig.get('from_step')}->"
+                               f"{mig.get('to_step')}")
+        self.registry.event("cache_cleared", ev)
+        if self._log is not None:
+            self._log.write({"serve_cache_cleared": True, **ev})
 
     # -- generation-keyed result cache (docs/SERVING.md "Result cache") ---
     def _result_cache_key(self, query: str, k: Optional[int],
@@ -1385,7 +1478,16 @@ class SearchService:
         """(normalized text, k, nprobe, store gen, index gen) — or None
         when the cache is off. Generations in the KEY are the whole
         invalidation story: refresh() bumps them, so an entry filled
-        against the old view can never answer a post-swap probe."""
+        against the old view can never answer a post-swap probe.
+
+        The store-gen slot COMPOSES the view's model stamp into its high
+        32 bits (docs/MAINTENANCE.md "Rolling model migration"): scores
+        cached under one encoder must never answer a query encoded by
+        another, even across a stamp flip that somehow left both
+        generation numbers unchanged — e.g. a restored-from-backup store
+        whose counters ran behind. One u64 keeps the peer-cache wire
+        format (`transport._CACHE_HEAD`) and cross-front-end keys
+        byte-identical without a protocol bump."""
         if self._rcache_cap <= 0:
             return None
         if view is None:
@@ -1394,8 +1496,10 @@ class SearchService:
             return None          # partitioned serving caches per-request
         index_gen = (view.index.index_generation
                      if view.index is not None else -1)
+        sgen = ((int(view.generation) & 0xFFFFFFFF)
+                | ((int(view.store.model_step or 0) & 0xFFFFFFFF) << 32))
         return (self._normalize(query), int(k or self.cfg.eval.recall_k),
-                int(nprobe or 0), int(view.generation), int(index_gen))
+                int(nprobe or 0), sgen, int(index_gen))
 
     def _result_cache_get(self, key: Optional[tuple],
                           count: bool = True) -> Optional[list]:
@@ -1571,13 +1675,40 @@ class SearchService:
                               np.asarray(ids).reshape(-1)))
         return True
 
-    def _embed_queries_cached(self, queries: Sequence[str]) -> np.ndarray:
-        """[n] texts -> [n, D] fp32 host query vectors, through the LRU
-        cache; only the misses pay tokenize + compiled encode (in
-        query_batch buckets). Host-side vectors cost the queries one device
-        round trip per bucket — amortized over the coalesced batch, and the
-        price of cache hits skipping the encode dispatch entirely."""
-        step = self.store.model_step
+    def _tower_params(self, step) -> object:
+        """Query-tower params for `step`: the extra tower attached by
+        begin_migration() when one is loaded for that stamp, else THE
+        embedder's own params (snapshot read — the tower map is whole-dict
+        swapped)."""
+        tw = self._towers
+        if step is not None and step in tw:
+            return tw[step]
+        return self.embedder.params
+
+    def _embed_queries_cached(self, queries: Sequence[str],
+                              steps: Optional[Sequence[int]] = None
+                              ) -> np.ndarray:
+        """[n] texts -> [n, D] fp32 host query vectors — or, when `steps`
+        lists more than one model stamp (dual-stamp serving,
+        docs/MAINTENANCE.md "Rolling model migration"), [n, S*D] with one
+        D-wide block per stamp in ascending-step order; `_qv_blocks` is
+        the inverse. Each stamp encodes through the matching tower and its
+        own cache keyspace."""
+        if steps is None or len(steps) <= 1:
+            return self._embed_queries_step(
+                queries, steps[0] if steps else self.store.model_step)
+        return np.concatenate(
+            [self._embed_queries_step(queries, s) for s in steps], axis=1)
+
+    def _embed_queries_step(self, queries: Sequence[str],
+                            step) -> np.ndarray:
+        """[n] texts -> [n, D] fp32 host query vectors for ONE model
+        stamp, through the LRU cache; only the misses pay tokenize +
+        compiled encode (in query_batch buckets). Host-side vectors cost
+        the queries one device round trip per bucket — amortized over the
+        coalesced batch, and the price of cache hits skipping the encode
+        dispatch entirely."""
+        params = self._tower_params(step)
         keys = [(step, self._normalize(q)) for q in queries]
         out = np.zeros((len(queries), self.store.dim), np.float32)
         miss: List[int] = []
@@ -1630,7 +1761,7 @@ class SearchService:
                                       tokens=int(enc.shape[1]))
             with self._stage("encode", queries=len(grp)):
                 vecs = np.asarray(
-                    self.embedder._encode_query(self.embedder.params,
+                    self.embedder._encode_query(params,
                                                 self.embedder._put(enc)),
                     np.float32)[: len(grp)]
             out[grp] = vecs
@@ -2032,7 +2163,20 @@ class SearchService:
                      n: int, k: int,
                      nprobe: Optional[int] = None,
                      deadline: Optional[float] = None) -> List[List[Dict]]:
-        qv = self._embed_queries_cached(queries)
+        # mid-migration the view serves two stamps: encode the batch once
+        # per stamp (stacked [n, S*D]) so every shard can be scored by the
+        # tower matching its recorded model step; the stacked matrix ships
+        # over the scatter paths unchanged (VQUERY frames carry a dynamic
+        # dim) and each receiver splits it against ITS view's stamp list.
+        # The kwarg only appears when the view's stamp table disagrees
+        # with the serving model step — two stamps mid-sweep, or one
+        # stamp that isn't the manifest's (a crash landed between the
+        # last unit flip and complete()'s stamp flip): model-free tests
+        # swap in single-argument embed stubs on the common path.
+        qv = (self._embed_queries_cached(queries, steps=view.steps)
+              if len(view.steps) > 1
+              or (view.steps and view.steps[0] != view.store.model_step)
+              else self._embed_queries_cached(queries))
         fanout = self._fanout
         if fanout is not None and fanout.active():
             # over-the-wire scatter (infer/partition_host.py): the RPC
@@ -2095,8 +2239,15 @@ class SearchService:
         bytes, or the view's full row bytes on the exact path — the
         per-partition critical-path byte count the partitioned bench
         phase records (drops ~1/P under partitioning)."""
+        qv = np.asarray(qv, np.float32)
+        blocks = self._qv_blocks(view, qv)
         if self._serve_index == "ivf":
-            res = self._ann_topk(view, qv, n, k, nprobe)
+            # a mixed-stamp view never consults the index (_ann_topk's
+            # migration guard): each shard must be scored by its own
+            # tower's block, which the exact path below routes per shard
+            res = (self._ann_topk(view, next(iter(blocks.values())),
+                                  n, k, nprobe)
+                   if len(view.steps) <= 1 else None)
             if res is not None:
                 return res
             # exact path serves this request; visible in metrics + counters
@@ -2107,45 +2258,110 @@ class SearchService:
         if view.shards is None:
             # streaming store: pad the query matrix to a bucket multiple so
             # every call reuses one compiled shape, then sweep disk ONCE
-            # for the whole list. The sweep reads the VIEW's store handle —
-            # refresh() never mutates it (it opens a fresh handle for the
-            # next view), so a swap mid-sweep cannot mix generations, while
-            # an in-place store mutation (ensure_model_step under a live
-            # service) still propagates per request like it always did.
-            # A RESTRICTED (partition) view sweeps its frozen entry subset
-            # instead — its shard range is the ownership contract.
-            qp = qv[:n]
-            pad = (-n) % B
-            if pad:
-                qp = np.concatenate(
-                    [qp, np.zeros((pad, qp.shape[1]), np.float32)])
-            self._note_dispatch_shape("topk_over_store", batch=B, k=k)
-            with self._stage("topk", path="streaming"):
-                scores, ids = topk_over_store(
-                    qp, view.store, self.embedder.mesh, k=k, query_batch=B,
-                    entries=view.entries if view.restricted else None)
+            # per stamp group (one group total outside a migration). The
+            # sweep reads the VIEW's store handle — refresh() never mutates
+            # it (it opens a fresh handle for the next view), so a swap
+            # mid-sweep cannot mix generations, while an in-place store
+            # mutation (ensure_model_step under a live service) still
+            # propagates per request like it always did. A RESTRICTED
+            # (partition) view sweeps its frozen entry subset instead —
+            # its shard range is the ownership contract.
+            groups: Dict = {}
+            for e in view.entries:
+                groups.setdefault(view.store.entry_step(e), []).append(e)
             scan = sum(e["count"] for e in view.entries) * row_bytes
-            return scores[:n], ids[:n], scan
+            fallback = next(iter(blocks.values()))
+
+            def _sweep(step, entries):
+                qp = blocks.get(step, fallback)[:n]
+                pad = (-n) % B
+                if pad:
+                    qp = np.concatenate(
+                        [qp, np.zeros((pad, qp.shape[1]), np.float32)])
+                self._note_dispatch_shape("topk_over_store", batch=B, k=k)
+                return topk_over_store(
+                    qp, view.store, self.embedder.mesh, k=k,
+                    query_batch=B, entries=entries)
+
+            if len(groups) <= 1:
+                step = next(iter(groups)) if groups else None
+                with self._stage("topk", path="streaming"):
+                    scores, ids = _sweep(
+                        step, view.entries if view.restricted else None)
+                return scores[:n], ids[:n], scan
+            out_s = np.full((n, k), -np.inf, np.float32)
+            out_i = np.full((n, k), -1, np.int64)
+            with self._stage("topk", path="streaming",
+                             stamps=len(groups)):
+                for step, entries in groups.items():
+                    s_g, i_g = _sweep(step, entries)
+                    out_s, out_i = _merge_topk_host(
+                        out_s, out_i, np.asarray(s_g[:n], np.float32),
+                        np.asarray(i_g[:n], np.int64), k)
+            return out_s, out_i, scan
         # Two passes over the buckets: dispatch them ALL first (the merge
         # output stays on device — JAX's async queue runs bucket i+1's
         # top-k while bucket i's packed transfer drains), THEN materialize
         # in order. A >bucket batch therefore pipelines compute against
         # transfer instead of serializing dispatch/drain per bucket.
-        pending = [(s, self._dispatch_bucket(view, qv[s: s + B], k))
+        pending = [(s, self._dispatch_bucket(
+                        view, {st: blk[s: s + B]
+                               for st, blk in blocks.items()}, k))
                    for s in range(0, n, B)]
         out_s = np.full((n, k), -np.inf, np.float32)
         out_i = np.full((n, k), -1, np.int64)
-        for s0, (nreal, q, packed) in pending:
-            bs, bi = self._collect_bucket(view, nreal, q, packed, k)
+        for s0, (nreal, qs, packed) in pending:
+            bs, bi = self._collect_bucket(view, nreal, qs, packed, k)
             out_s[s0: s0 + nreal] = bs[:nreal]
             out_i[s0: s0 + nreal] = bi[:nreal]
         scan = (sum(nv for _, nv, _, _ in view.shards)
                 + sum(e["count"] for e in view.stream_entries)) * row_bytes
         return out_s, out_i, scan
 
+    def _qv_blocks(self, view: "_ServeView",
+                   qv: np.ndarray) -> Dict:
+        """Split a query matrix into per-stamp [n, D] blocks keyed by
+        model step, ascending — the inverse of the stacked encode in
+        _embed_queries_cached (docs/MAINTENANCE.md "Rolling model
+        migration"). Handles the two transient skews a rolling fleet
+        walk-through can produce:
+
+          * WIDE matrix onto a single-stamp view (a dual-stamp front end
+            scattering to a receiver whose store handle hasn't caught the
+            migration record yet, or already passed the completion flip):
+            pick this view's block by the migration record's
+            ascending-stamp order, else the LAST block — completion skew
+            is the common case and the target stamp stacks last;
+          * NARROW matrix onto a mixed view (an encoder predating the
+            record): score every shard with the one block — old-stamp
+            shards exactly, new-stamp shards approximately, for the one
+            refresh round it takes the caller to catch up (counted as
+            `serve_stamp_skew`)."""
+        D = int(view.store.dim)
+        w = int(qv.shape[1])
+        steps = view.steps
+        if len(steps) <= 1:
+            step = steps[0] if steps else None
+            if w <= D:
+                return {step: qv}
+            nb = w // D
+            mig = view.store.migration or {}
+            order = sorted({int(s) for s in (mig.get("from_step"),
+                                             mig.get("to_step"))
+                            if s is not None})
+            pos = (order.index(step)
+                   if step in order and order.index(step) < nb
+                   else nb - 1)
+            return {step: qv[:, pos * D:(pos + 1) * D]}
+        if w <= D:
+            self._count_fault("serve_stamp_skew")
+            return {s: qv for s in steps}
+        nb = w // D
+        return {s: qv[:, min(i, nb - 1) * D: (min(i, nb - 1) + 1) * D]
+                for i, s in enumerate(steps)}
+
     # graftcheck: hot
-    def _dispatch_bucket(self, view: "_ServeView", qblock: np.ndarray,
-                         k: int):
+    def _dispatch_bucket(self, view: "_ServeView", qblocks: Dict, k: int):
         """HBM-resident fast path for ONE compiled bucket (<= query_batch
         real rows): every resident shard's top-k program dispatches under
         JAX's async queue and the cross-shard merge runs ON DEVICE; the
@@ -2154,28 +2370,39 @@ class SearchService:
         regardless of shard count or how many queries share the dispatch.
         (The old per-shard host merge cost ~2 transfers per shard: ~100 ms
         each over a tunneled chip, and a forced pipeline bubble even on
-        local PCIe.)"""
+        local PCIe.)
+
+        `qblocks` maps model stamp -> [<=B, D] query block (_qv_blocks):
+        each shard is scored by the block matching its recorded stamp, so
+        a mid-migration bucket runs the same one merged dispatch — the
+        dual-stamp routing costs one extra h2d put per extra stamp, not a
+        second sweep."""
         import jax.numpy as jnp
 
-        nreal = qblock.shape[0]
+        nreal = next(iter(qblocks.values())).shape[0]
         B = self.query_batch
-        if nreal < B:
-            qblock = np.concatenate(
-                [qblock, np.zeros((B - nreal, qblock.shape[1]), np.float32)])
-        q = jnp.asarray(qblock, jnp.float32)
+        qs: Dict = {}
+        for st, blk in qblocks.items():
+            if blk.shape[0] < B:
+                blk = np.concatenate(
+                    [blk, np.zeros((B - blk.shape[0], blk.shape[1]),
+                                   np.float32)])
+            qs[st] = jnp.asarray(blk, jnp.float32)
+        fallback = next(iter(qs.values()))
         self._note_dispatch_shape("sharded_topk", batch=B, k=k,
                                   rows=view.pad_rows,
                                   shards=len(view.shards))
         with self._stage("topk", shards=len(view.shards)):
             cands = [
-                sharded_topk(q, pages, self.embedder.mesh, k=k, valid=n,
-                             scales=scl)
-                for _, n, pages, scl in view.shards]
+                sharded_topk(qs.get(st, fallback), pages,
+                             self.embedder.mesh, k=k, valid=n, scales=scl)
+                for st, (_, n, pages, scl) in zip(view.shard_steps,
+                                                  view.shards)]
             packed = view.merge(cands)                 # async, on device
-        return nreal, q, packed
+        return nreal, qs, packed
 
     # graftcheck: hot
-    def _collect_bucket(self, view: "_ServeView", nreal: int, q, packed,
+    def _collect_bucket(self, view: "_ServeView", nreal: int, qs, packed,
                         k: int):
         """Drain one dispatched bucket to host (scores [nreal, k] fp32,
         page_ids [nreal, k] int64) — formatting happens once per call in
@@ -2207,17 +2434,22 @@ class SearchService:
                 # (degraded tail reads disk, no device involved)
                 yield np.asarray(ids, np.int64), np.asarray(vecs), scl
 
+        fallback = next(iter(qs.values()))
         with self._stage("topk", path="degraded_tail",
                          shards=len(view.stream_entries)):
-            for ids, vecs, scl in read_ahead(_load_tail(), depth=1):
+            tail = read_ahead(_load_tail(), depth=1)
+            for entry, (ids, vecs, scl) in zip(view.stream_entries, tail):
                 nrows = vecs.shape[0]
                 if nrows == 0:
                     continue
                 pages, scales = stage_shard(vecs, view.pad_rows,
                                             view.store.dim,
                                             self.embedder.mesh, scales=scl)
+                # the degraded tail routes by stamp too: a failed-to-stage
+                # shard still scores against its own tower's block
+                q_e = qs.get(view.store.entry_step(entry), fallback)
                 best_s, best_i = merge_shard_topk(
-                    q, pages, ids, nrows, self.embedder.mesh, k,
+                    q_e, pages, ids, nrows, self.embedder.mesh, k,
                     best_s, best_i, scales=scales)
         return best_s[:nreal], best_i[:nreal]
 

@@ -165,7 +165,12 @@ _HELLO_HEAD = struct.Struct("!B")         # capability flags
 _REFRESH_HEAD = struct.Struct("!Q")       # store generation (legacy)
 _REFRESH_HEAD2 = struct.Struct("!QI")     # ... + partition-split width
 # result-cache key head: req id, k, nprobe, store generation, index
-# generation (signed; -1 = the view serves without an index), text len
+# generation (signed; -1 = the view serves without an index), text len.
+# The store-generation word is COMPOSED, not raw: the low 32 bits carry
+# the store's folded generation and the high 32 the serving model stamp
+# (docs/MAINTENANCE.md "Rolling model migration"), so a cached result
+# stamped by one tower can never answer for the other — the wire codec
+# treats the u64 opaquely and needs no migration awareness.
 _CACHE_HEAD = struct.Struct("!QiiQqH")
 
 _REQ_IDS = itertools.count(1)

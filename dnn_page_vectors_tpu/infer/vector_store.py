@@ -268,8 +268,42 @@ class VectorStore:
     def model_step(self) -> Optional[int]:
         """The model step this store's vectors were embedded at (None for a
         pre-stamp store). Serving keys its query-embedding cache on this, so
-        ensure_model_step / a store reload invalidates cached embeddings."""
+        ensure_model_step / a store reload invalidates cached embeddings.
+        During a rolling migration (docs/MAINTENANCE.md "Rolling model
+        migration") this stays the FROM stamp until the completion flip —
+        per-shard stamps are read through entry_step()."""
         return self.manifest.get("model_step")
+
+    # -- rolling model migration (docs/MAINTENANCE.md) ---------------------
+    @property
+    def migration(self) -> Optional[Dict]:
+        """The active rolling-migration record ({"from_step", "to_step"}),
+        or None. While present, shards legitimately carry EITHER stamp —
+        one stamp per shard, never mixed within one — and serving routes
+        queries per shard by entry_step()."""
+        return self.manifest.get("migration")
+
+    @property
+    def migration_epoch(self) -> int:
+        """Monotonic count of migration manifest flips this store has ever
+        committed. Folded into `generation`, so every migrate swap moves
+        the SAME number the refresh broadcast, the worker eligibility
+        gate, and the result-cache key already gate on — the
+        no-mixed-generations machinery extends to stamp flips for free."""
+        return int(self.manifest.get("migration_epoch", 0))
+
+    def entry_step(self, entry: Dict) -> Optional[int]:
+        """The model stamp one shard entry's vectors were embedded at: the
+        entry's own recorded stamp (migrated base shards, annotated
+        generation shards), falling back to the store stamp."""
+        return entry.get("model_step", self.manifest.get("model_step"))
+
+    def model_steps(self) -> List[int]:
+        """Distinct model stamps across the live shard table, ascending.
+        One element outside a migration window; two while a rolling
+        migration is mid-sweep."""
+        return sorted({s for s in (self.entry_step(e) for e in self.shards())
+                       if s is not None})
 
     def _writer_files(self) -> List[str]:
         return sorted(p for p in glob.glob(
@@ -370,7 +404,13 @@ class VectorStore:
     def ensure_model_step(self, step: int) -> None:
         """Stale-store invariant (one call site per topology, decided ONCE
         before any writer starts): vectors embedded at another model step
-        are stale, not resumable work — reset, then stamp the new step."""
+        are stale, not resumable work — reset, then stamp the new step.
+        EXCEPT mid-migration: a rolling migration owns the stamp lifecycle
+        (docs/MAINTENANCE.md), so asking for either endpoint of an active
+        migration is a no-op instead of a store wipe."""
+        mig = self.manifest.get("migration")
+        if mig and step in (mig.get("from_step"), mig.get("to_step")):
+            return
         if self.manifest.get("model_step") != step:
             self.reset()
         self.manifest["model_step"] = step
@@ -409,6 +449,13 @@ class VectorStore:
         self._tomb_gen = {}
         self._dead_cache = {}
         step = self.manifest.get("model_step")
+        # mid-migration, generations legitimately sit at EITHER endpoint
+        # stamp — both are intact chain members, not stale strays; a gen
+        # whose shards were re-embedded carries its migrated entries as a
+        # main-manifest override instead (docs/MAINTENANCE.md)
+        mig = self.manifest.get("migration") or {}
+        ok_steps = {step, mig.get("from_step"), mig.get("to_step")} \
+            if mig else {step}
         g = int(self.manifest.get("compacted_through", 0)) + 1
         while True:
             mpath = os.path.join(self._gen_path(g), "manifest.json")
@@ -426,7 +473,9 @@ class VectorStore:
                     f"moved aside to {q}; serving the store without "
                     f"generation {g} and anything after it")
                 break
-            if man.get("gen") != g or man.get("model_step") != step:
+            if man.get("gen") != g or (man.get("model_step") not in ok_steps
+                                       and self._gen_override(g, man)
+                                       is None):
                 faults.count("stale_generations")
                 faults.warn(
                     f"generation {g} at {mpath} is stale (gen="
@@ -436,7 +485,38 @@ class VectorStore:
             self._register_generation(man)
             g += 1
 
+    def _gen_override(self, g: int, man: Dict) -> Optional[List[Dict]]:
+        """Migrated replacement entries for generation `g`, or None. They
+        live in the MAIN manifest (docs/MAINTENANCE.md "Rolling model
+        migration") so each per-generation migration commit is ONE atomic
+        dump — no two-manifest crash window. Applied only while the
+        recorded source CRCs still match the generation manifest on disk:
+        a quarantined-and-reused generation number can never resurrect a
+        stale override."""
+        ov = (self.manifest.get("gen_overrides") or {}).get(str(int(g)))
+        if not ov:
+            return None
+        src = [e.get("crc", {}).get("vec") for e in man.get("shards", [])]
+        if src != ov.get("src_vec_crc"):
+            return None
+        return ov.get("shards")
+
     def _register_generation(self, man: Dict) -> None:
+        ov = self._gen_override(int(man["gen"]), man)
+        if ov is not None:
+            # the effective view of a migrated generation: its re-embedded
+            # entries (and their stamp) supersede the manifest's own
+            man = dict(man)
+            man["shards"] = [dict(e) for e in ov]
+            steps = {e.get("model_step") for e in man["shards"]}
+            if len(steps) == 1 and None not in steps:
+                man["model_step"] = steps.pop()
+        # annotate each entry with its owning manifest's stamp so the
+        # merged shards() table is stamp-addressable without re-resolving
+        # ownership (entry_step; docs/MAINTENANCE.md "Rolling model
+        # migration")
+        for s in man.get("shards", []):
+            s.setdefault("model_step", man.get("model_step"))
         self._generations.append(man)
         g = int(man["gen"])
         for t in man.get("tombstones", []):
@@ -453,7 +533,20 @@ class VectorStore:
     def generation(self) -> int:
         """Current store generation (0 = base embed only). Monotonic across
         compactions: folded generations still count, so the next append
-        always chains past every generation number ever committed."""
+        always chains past every generation number ever committed.
+        Migration manifest flips fold in through migration_epoch, so a
+        stamp flip bumps the generation every reader/peer gates on even
+        though no generation was appended."""
+        return (int(self.manifest.get("compacted_through", 0))
+                + len(self._generations)
+                + int(self.manifest.get("migration_epoch", 0)))
+
+    @property
+    def chain_generation(self) -> int:
+        """Top generation NUMBER in the append chain (compacted_through +
+        intact generations) — the gen-NNNN numbering cursor. Unlike
+        `generation` this excludes migration_epoch: migrate flips move what
+        readers gate on, not where the next gen-NNNN directory lands."""
         return (int(self.manifest.get("compacted_through", 0))
                 + len(self._generations))
 
@@ -600,7 +693,7 @@ class VectorStore:
         before it. `tombstones` are the page ids this generation kills in
         EARLIER generations (deleted pages, or pages about to be
         re-appended with fresh vectors)."""
-        return GenerationWriter(self, self.generation + 1,
+        return GenerationWriter(self, self.chain_generation + 1,
                                 tombstones=tombstones)
 
     def reset(self) -> None:
@@ -616,7 +709,7 @@ class VectorStore:
                     pass
         for path in self._writer_files():
             os.remove(path)
-        for pat in ("gen-*", "compact-*"):
+        for pat in ("gen-*", "compact-*", "migrate-*"):
             for path in glob.glob(os.path.join(self.directory, pat)):
                 if os.path.isdir(path):
                     shutil.rmtree(path, ignore_errors=True)
@@ -627,6 +720,11 @@ class VectorStore:
         self.manifest.pop("missing_id_ranges", None)
         self.manifest.pop("compacted_through", None)
         self.manifest.pop("append_cursor", None)
+        # a reset abandons any mid-sweep migration wholesale; the epoch
+        # counter stays (monotonic forever — generation-keyed consumers
+        # must never see it move backward)
+        self.manifest.pop("migration", None)
+        self.manifest.pop("gen_overrides", None)
         self._writer_shards = []
         self._flush_manifest()
 
@@ -923,9 +1021,9 @@ class GenerationWriter:
 
     def __init__(self, store: VectorStore, gen: int, tombstones=()):
         import shutil
-        if gen != store.generation + 1:
+        if gen != store.chain_generation + 1:
             raise ValueError(f"generation {gen} cannot be opened: the chain "
-                             f"is at {store.generation}")
+                             f"is at {store.chain_generation}")
         self.store = store
         self.gen = int(gen)
         self.tombstones = sorted({int(t) for t in tombstones})

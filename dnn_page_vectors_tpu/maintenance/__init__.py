@@ -8,18 +8,25 @@ corpus healthy WHILE it serves, with zero reader-visible pauses.
   * `lease` — per-writer append leases on the id cursor, so concurrent
     `cli append` processes queue or fail fast instead of double-assigning
     page ids;
+  * `migrate` — rolling model migration: re-embed a live store to a new
+    model step unit-by-unit (base, then each generation) with an atomic
+    per-unit manifest flip, while serving runs dual-stamp
+    (docs/MAINTENANCE.md "Rolling model migration");
   * `service` — the supervised `MaintenanceService` worker pool (one
-    worker per pillar: compactor, off-path index rebuilder, janitor),
-    driven by `cli maintain [--once]` or attached in-process via
-    `SearchService.start_maintenance()`.
+    worker per pillar: compactor, off-path index rebuilder, janitor,
+    autoscaler, migrator), driven by `cli maintain [--once]` or attached
+    in-process via `SearchService.start_maintenance()`.
 """
 from dnn_page_vectors_tpu.maintenance.compact import (
     compact_store, purge_stale)
 from dnn_page_vectors_tpu.maintenance.lease import (
     AppendLease, LeaseHeld, LeaseLost, expire_stale_lease)
+from dnn_page_vectors_tpu.maintenance.migrate import (
+    MigrationPlan, migrate_store)
 from dnn_page_vectors_tpu.maintenance.service import MaintenanceService
 
 __all__ = [
     "AppendLease", "LeaseHeld", "LeaseLost", "MaintenanceService",
-    "compact_store", "expire_stale_lease", "purge_stale",
+    "MigrationPlan", "compact_store", "expire_stale_lease",
+    "migrate_store", "purge_stale",
 ]

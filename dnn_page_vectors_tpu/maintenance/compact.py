@@ -62,8 +62,14 @@ def compact_store(store, registry=None) -> Dict:
         raise ValueError(
             f"store at {store.directory} has live writer manifests (an "
             "embed fleet is mid-flight); compact after merge_writers()")
+    if store.migration is not None:
+        # folding would merge shards that carry DIFFERENT model stamps into
+        # one output shard, breaking the one-stamp-per-shard routing pin —
+        # the migrate pillar re-runs compaction after the completion flip
+        return {"action": "noop", "reason": "migration in flight",
+                "generation": store.generation}
     prev_epoch = store.compacted_through
-    epoch = store.generation
+    epoch = store.chain_generation
     if epoch <= prev_epoch:
         return {"action": "noop", "reason": "no generations to fold",
                 "generation": epoch}
@@ -153,6 +159,10 @@ def compact_store(store, registry=None) -> Dict:
     man = dict(store.manifest)
     man["shards"] = new_entries
     man["compacted_through"] = epoch
+    # every generation 1..epoch is folded, so any migrated-entry overrides
+    # for them are folded too (docs/MAINTENANCE.md "Rolling model
+    # migration")
+    man.pop("gen_overrides", None)
     man["append_cursor"] = max(int(man.get("append_cursor", 0)),
                                cursor_before)
     store._atomic_dump(man, store._manifest_path, op="compact_swap")
@@ -168,7 +178,7 @@ def compact_store(store, registry=None) -> Dict:
                    for k in ("vec", "ids", "scl") if k in e}
     stale_dirs += [os.path.join(store.directory, sd)
                    for sd in sorted(old_subdirs - {"", subdir})
-                   if sd.startswith("compact-")]
+                   if sd.startswith(("compact-", "migrate-"))]
     stale_files = [os.path.join(store.directory, e[k])
                    for e in old_entries
                    for k in ("vec", "ids", "scl")

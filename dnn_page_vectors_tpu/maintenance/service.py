@@ -22,6 +22,13 @@ flips):
     left behind. Old artifacts are deleted one full cycle after they go
     stale, so in-flight readers on the previous view never lose a file
     mid-query;
+  * **migrator** (docs/MAINTENANCE.md "Rolling model migration") — once
+    armed via `request_migration`, re-embed the live store to a new model
+    step one unit per pass (base, then each generation, oldest first)
+    through `MigrationPlan`, hot-swapping the serving view between units
+    so the fleet walks through the stamp flip with no restarts; on
+    completion rebuild the index over the new stamp and let the serving
+    refresh retire the old tower;
   * **autoscaler** (docs/SCALING.md "Scale-out tier") — ladder the
     worker-fleet size off the serving telemetry: windowed queue-wait p99
     or deadline-shed rate over the up-thresholds spawns the next tail
@@ -57,6 +64,7 @@ from dnn_page_vectors_tpu.infer.vector_store import VectorStore
 from dnn_page_vectors_tpu.maintenance.compact import (
     compact_store, purge_stale)
 from dnn_page_vectors_tpu.maintenance.lease import expire_stale_lease
+from dnn_page_vectors_tpu.maintenance.migrate import MigrationPlan
 from dnn_page_vectors_tpu.utils import faults, telemetry
 
 _INDEX_DIR_RE = re.compile(r"^ivf(-\d+)?$")
@@ -78,7 +86,7 @@ class MaintenanceService:
     service's `full_rebuilds` — the acceptance pin that rebuilds happen
     ONLY here, never on the refresh caller."""
 
-    PILLARS = ("compaction", "rebuild", "janitor", "autoscale")
+    PILLARS = ("compaction", "rebuild", "janitor", "autoscale", "migrate")
 
     def __init__(self, cfg, store_dir: str, mesh, svc=None, registry=None):
         self._cfg = cfg
@@ -121,6 +129,16 @@ class MaintenanceService:
         self._drain_hook: Optional[Callable[[int], None]] = None
         self._size_hook: Optional[Callable[[], int]] = None
         self._last_scale_t: Optional[float] = None
+        # migrate pillar knobs (docs/MAINTENANCE.md "Rolling model
+        # migration")
+        mg = getattr(cfg, "migrate", None)
+        self._mig_batch_rows = int(getattr(mg, "batch_rows", 4096)
+                                   if mg is not None else 4096)
+        self._mig_units = int(getattr(mg, "units_per_pass", 1)
+                              if mg is not None else 1)
+        self._mig_purge = bool(getattr(mg, "purge", True)
+                               if mg is not None else True)
+        self._migrate_req: Optional[Dict] = None   # guarded-by: _mlock
         # injectable for the fake-clock pillar-ladder tests
         self._clock: Callable[[], float] = time.monotonic
         self._lock = threading.Lock()
@@ -146,7 +164,8 @@ class MaintenanceService:
         for name, job in (("compaction", self._compact_once),
                           ("rebuild", self._rebuild_once),
                           ("janitor", self._janitor_once),
-                          ("autoscale", self._autoscale_once)):
+                          ("autoscale", self._autoscale_once),
+                          ("migrate", self._migrate_once)):
             t = threading.Thread(target=self._run_worker, args=(name, job),
                                  daemon=True, name=f"maint-{name}")
             self._threads.append(t)
@@ -235,6 +254,7 @@ class MaintenanceService:
             for name, job in (("janitor", self._janitor_once),
                               ("compaction", self._compact_once),
                               ("rebuild", self._rebuild_once),
+                              ("migrate", self._migrate_once),
                               ("autoscale", self._autoscale_once)):
                 res = self._guarded_job(name, job)
                 if res is not None:
@@ -260,8 +280,11 @@ class MaintenanceService:
         reg.gauge("maintenance.dead_rows").set(ms["dead_rows"])
         reg.gauge("maintenance.reclaimable_bytes").set(
             ms["reclaimable_bytes"])
-        if (store.generation <= store.compacted_through
+        if (store.migration is not None
+                or store.chain_generation <= store.compacted_through
                 or ms["tombstone_density"] < self._density):
+            # mid-migration, folding would mix stamps within one shard —
+            # the migrate pillar owns the store until the completion flip
             return None
         store = VectorStore(self._store_dir)     # verified handle
         had_index = os.path.exists(os.path.join(
@@ -294,6 +317,11 @@ class MaintenanceService:
     # -- pillar: off-path index rebuilds -----------------------------------
     def _rebuild_once(self) -> Optional[Dict]:
         svc = self._svc
+        if VectorStore(self._store_dir, verify=False).migration is not None:
+            # an index built mid-migration would span two encoders'
+            # geometries; serving runs exact on mixed-stamp views and the
+            # migrate pillar rebuilds at the completion flip
+            return None
         reason = None
         if svc is not None:
             if svc._serve_index != "ivf":
@@ -400,6 +428,72 @@ class MaintenanceService:
         faults.count("index_bg_rebuilds")
         return rb
 
+    # -- pillar: rolling model migration -----------------------------------
+    def request_migration(self, to_step: int, corpus, embedder) -> None:
+        """Arm the migrate pillar: re-embed the store to `to_step` with
+        `embedder` (built over the NEW model's params) reading page text
+        from `corpus`. The pillar then sweeps one unit per pass, hot-
+        swapping the serving view between units; with a service attached
+        its query path goes dual-stamp immediately (begin_migration) so
+        queries route per shard stamp mid-sweep."""
+        with self._mlock:
+            self._migrate_req = {"to_step": int(to_step), "corpus": corpus,
+                                 "embedder": embedder}
+        if self._svc is not None:
+            self._svc.begin_migration(embedder.params, int(to_step))
+
+    def _migrate_once(self) -> Optional[Dict]:   # holds-lock: _mlock
+        req = self._migrate_req
+        if req is None:
+            return None
+        store = VectorStore(self._store_dir)      # verified handle
+        plan = MigrationPlan(store, req["corpus"], req["embedder"],
+                             req["to_step"], registry=self.registry,
+                             batch_rows=self._mig_batch_rows)
+        begun = plan.begin()
+        if begun.get("action") == "noop":
+            self._migrate_req = None
+            return begun
+        units = plan.pending_units()
+        if units:
+            out: Dict = {**begun, "action": "migrating"}
+            out["units"], out["rows"], stale = [], 0, []
+            for unit in units[: self._mig_units]:
+                st = plan.migrate_unit(unit)
+                out["units"].append(int(unit))
+                out["rows"] += int(st.get("rows", 0))
+                stale += st.get("stale_files", [])
+            if self._svc is not None:
+                # the fleet walks onto the re-embedded unit now — the
+                # epoch bump rides the same refresh generation gate every
+                # other manifest flip uses
+                info = self._svc.refresh()
+                out["refresh_swap_ms"] = info.get("swap_ms")
+            if self._mig_purge:
+                # superseded old-stamp bytes, reclaimed only after the
+                # serving view moved over (same rule as compaction)
+                out["purged"] = purge_stale(store, {"stale_files": stale})
+            return out
+        fin = plan.complete()
+        if fin is None:
+            return None
+        had_index = os.path.exists(os.path.join(
+            store.directory, store.index_dirname, "manifest.json"))
+        if had_index:
+            # rebuild over the NEW stamp before the final refresh: ANN ran
+            # degraded-to-exact through the dual-stamp window, and the
+            # completion swap lands stamp + index together
+            fin["index_rebuild"] = self._swap_index(
+                store, reason=f"model migration to step {req['to_step']}",
+                refresh=False)
+        if self._svc is not None:
+            # this refresh adopts the new query tower and unloads the old
+            # one (SearchService.refresh, docs/SERVING.md)
+            info = self._svc.refresh()
+            fin["refresh_swap_ms"] = info.get("swap_ms")
+        self._migrate_req = None
+        return fin
+
     # -- pillar: autoscale (docs/SCALING.md "Scale-out tier") --------------
     def _autoscale_once(self) -> Optional[Dict]:
         """One policy evaluation: read the windowed pressure signals off
@@ -469,7 +563,8 @@ class MaintenanceService:
     def _janitor_once(self) -> Optional[Dict]:
         store = VectorStore(self._store_dir, verify=False)
         out = {"lease_expired": False, "index_dirs_removed": 0,
-               "purged_dirs": 0, "purged_files": 0}
+               "migrate_dirs_removed": 0, "purged_dirs": 0,
+               "purged_files": 0}
         if expire_stale_lease(store, registry=self.registry):
             out["lease_expired"] = True
             self.registry.counter("maintenance.leases_expired").inc()
@@ -483,6 +578,17 @@ class MaintenanceService:
                 continue
             shutil.rmtree(path, ignore_errors=True)
             out["index_dirs_removed"] += 1
+        # migration unit dirs no manifest references any more: a crashed
+        # attempt's torn unit, or a unit a later migration/compaction
+        # superseded (docs/MAINTENANCE.md "Rolling model migration")
+        ref_dirs = {os.path.dirname(e[k]) for e in store.shards()
+                    for k in ("vec", "ids", "scl") if k in e}
+        for path in sorted(glob.glob(os.path.join(store.directory,
+                                                  "migrate-*"))):
+            if (os.path.isdir(path)
+                    and os.path.basename(path) not in ref_dirs):
+                shutil.rmtree(path, ignore_errors=True)
+                out["migrate_dirs_removed"] += 1
         epoch = store.compacted_through
         if epoch:
             referenced = {os.path.dirname(e[k]) for e in store.shards()

@@ -39,7 +39,8 @@ class DeterminismRule(Rule):
            "byte-pinned embed/index/update/loadgen paths")
     scope = (f"{PKG_NAME}/infer/", f"{PKG_NAME}/index/",
              f"{PKG_NAME}/updates/", f"{PKG_NAME}/loadgen/workload.py",
-             f"{PKG_NAME}/maintenance/compact.py")
+             f"{PKG_NAME}/maintenance/compact.py",
+             f"{PKG_NAME}/maintenance/migrate.py")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):

@@ -56,6 +56,14 @@ Injection points (op names):
                  build's own writes still carry index_write/index_file
   lease_dump     append-lease file write (check; inside retry)
   lease_file     the lease tmp file before rename (corrupt)
+  migrate_write  per-shard re-stamped write during a rolling model
+                 migration (check; docs/MAINTENANCE.md "Rolling model
+                 migration" — the re-embedded shard FILES additionally go
+                 through shard_write/shard_file like every shard)
+  migrate_swap_dump  a migration unit's atomic main-manifest flip (check;
+                 inside retry) — tearing it here leaves the previous
+                 stamp mix serving and the migrate dir invisible
+  migrate_swap_file  the migration flip's tmp file before rename (corrupt)
 
 Wire injection points (docs/ROBUSTNESS.md "Network failure model") — the
 serve fleet's DPV1 frame paths call `active().wire(op)` and act on the

@@ -1,0 +1,520 @@
+"""Rolling model migration (docs/MAINTENANCE.md "Rolling model
+migration"): re-embed a LIVE store to a new model step unit-by-unit while
+it serves. Pins: the sweep is resumable and byte-deterministic, appends
+that land mid-sweep become pending units, the crash-anywhere fault matrix
+over migrate_write/migrate_swap_dump/migrate_swap_file leaves a serveable
+store at every commit point and resumes to completion, dual-stamp serving
+routes every shard through the tower that embedded it (top-1 exact on
+both stamps mid-sweep — a cross-tower scoring would be observably wrong,
+not merely noisy), the maintenance pillar sweeps a live service under a
+concurrent query hammer with zero errors and recall@10 >= 0.95, the
+result-cache key carries the serving model stamp so a pre-flip entry can
+never answer post-flip, and a socket client rides one connection through
+the whole migration (no restart anywhere).
+
+Model-free (the test_net / test_result_cache idiom): a deterministic
+(text, step) -> unit-vector stub stands in for the two towers, so the
+routing is discriminating — vectors from different steps are independent
+random directions, and only stamp-correct routing scores ~1.0.
+"""
+import os
+import shutil
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.maintenance.migrate import (
+    MigrationPlan, migrate_store)
+from dnn_page_vectors_tpu.utils import faults, telemetry
+
+pytestmark = pytest.mark.migrate
+
+DIM = 24
+SHARD = 40
+
+
+# ---------------------------------------------------------------------------
+# fixtures: two fake towers + a synthetic stamped store
+# ---------------------------------------------------------------------------
+
+def _vec(text, step):
+    """Deterministic unit vector keyed on (text, model step): the two
+    towers' embeddings of the SAME text are independent random directions,
+    so any cross-stamp scoring is observably wrong."""
+    seed = zlib.crc32(f"{int(step)}|{text}".encode()) & 0xFFFFFFFF
+    v = np.random.default_rng(seed).standard_normal(DIM).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+class _Corpus:
+    def page_text(self, i):
+        return f"page {int(i)}"
+
+
+class _Embedder:
+    """The page tower MigrationPlan drives: embed_texts at one step."""
+
+    def __init__(self, step, mesh=None):
+        self.step = int(step)
+        self.params = ("tower", int(step))
+        self.mesh = mesh
+        self.query_tok = None
+        self.page_tok = None
+
+    def embed_texts(self, texts, tower="page", batch_size=None):
+        return np.stack([_vec(t, self.step) for t in texts])
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    telemetry.reset_default()
+    yield
+    faults.reset()
+    telemetry.reset_default()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _build_store(sdir, nbase=2, gen_rows=(20,), step=1, corpus=None):
+    """nbase full base shards + one generation per gen_rows entry, every
+    row embedded at `step` from the corpus text (so querying a page's own
+    text is an exact self-hit under the matching tower)."""
+    corpus = corpus or _Corpus()
+    emb = _Embedder(step)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    store.ensure_model_step(step)
+    for si in range(nbase):
+        ids = np.arange(si * SHARD, (si + 1) * SHARD, dtype=np.int64)
+        store.write_shard(si, ids, emb.embed_texts(
+            [corpus.page_text(i) for i in ids]))
+    store = VectorStore(sdir)
+    for rows in gen_rows:
+        base = store.next_page_id()
+        ids = np.arange(base, base + rows, dtype=np.int64)
+        w = store.begin_generation()
+        w.write_shard(ids, emb.embed_texts(
+            [corpus.page_text(i) for i in ids]))
+        w.commit()
+        store = VectorStore(sdir)
+    return store
+
+
+def _append_gen(sdir, rows, step, corpus=None):
+    corpus = corpus or _Corpus()
+    store = VectorStore(sdir)
+    base = store.next_page_id()
+    ids = np.arange(base, base + rows, dtype=np.int64)
+    w = store.begin_generation()
+    w.write_shard(ids, _Embedder(step).embed_texts(
+        [corpus.page_text(i) for i in ids]))
+    w.commit()
+    return ids
+
+
+def _service(store, mesh, corpus=None, hbm=4.0, **serve_over):
+    import dataclasses
+
+    from dnn_page_vectors_tpu.infer.partition_host import MeshEmbedder
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM})
+    if serve_over:
+        cfg = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    **serve_over))
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                        preload_hbm_gb=hbm)
+
+    def _embed(queries, steps=None):
+        ss = list(steps) if steps is not None else []
+        if len(ss) <= 1:
+            use = ss[0] if ss else svc.store.model_step
+            return np.stack([_vec(q, use) for q in queries])
+        # the dual-stamp stacked block: one D-slice per stamp, ascending
+        return np.concatenate(
+            [np.stack([_vec(q, s) for q in queries]) for s in ss], axis=1)
+
+    svc._embed_queries_cached = _embed
+    svc.corpus = corpus or _Corpus()
+    return svc
+
+
+def _self_hit_ok(svc, pid, k=10):
+    hits = svc.search(f"page {int(pid)}", k=k)
+    return bool(hits) and int(hits[0]["page_id"]) == int(pid)
+
+
+def _assert_all_self_hits(svc, ids, what):
+    bad = [int(i) for i in ids if not _self_hit_ok(svc, i)]
+    assert not bad, f"{what}: routed to the wrong tower for pages {bad}"
+
+
+# ---------------------------------------------------------------------------
+# sweep mechanics
+# ---------------------------------------------------------------------------
+
+def test_sweep_is_byte_deterministic_across_drive_paths(tmp_path):
+    """migrate_store (the cli path) and unit-at-a-time begin/migrate_unit/
+    complete (the pillar path) over identical stores produce identical
+    migrated shard BYTES, a [2]-stamped store, and preserved ids."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _build_store(a), _build_store(b)
+    corpus = _Corpus()
+
+    out = migrate_store(VectorStore(a), corpus, _Embedder(2), 2)
+    assert out["action"] == "migrated" and out["completed"]
+    assert out["units"] == 2 and out["rows"] == 100
+
+    plan = MigrationPlan(VectorStore(b), corpus, _Embedder(2), 2)
+    assert plan.begin()["action"] == "started"
+    assert plan.pending_units() == [0, 1]          # oldest (base) first
+    for u in (0, 1):
+        st = plan.migrate_unit(u)
+        assert st["action"] == "migrated_unit" and st["rows"] > 0
+        assert st["stale_files"]                   # superseded old-stamp
+    fin = plan.complete()
+    assert fin == {"action": "completed", "from_step": 1, "to_step": 2}
+
+    for sdir in (a, b):
+        store = VectorStore(sdir)
+        assert store.model_step == 2 and store.model_steps() == [2]
+        assert store.migration is None and store.num_vectors == 100
+        assert all(store.entry_step(e) == 2 for e in store.shards())
+    sa, sb = VectorStore(a), VectorStore(b)
+    for ea, eb in zip(sa.shards(), sb.shards()):
+        assert ea["vec"] == eb["vec"] and ea["crc"] == eb["crc"]
+        for key in ("vec", "ids"):
+            with open(os.path.join(a, ea[key]), "rb") as f1, \
+                    open(os.path.join(b, eb[key]), "rb") as f2:
+                assert f1.read() == f2.read(), f"{ea[key]} diverged"
+    # re-running a finished migration is a noop, not a second sweep
+    assert migrate_store(VectorStore(a), corpus, _Embedder(2),
+                         2)["action"] == "noop"
+
+
+def test_appends_mid_sweep_become_pending_units(tmp_path):
+    sdir = str(tmp_path / "store")
+    _build_store(sdir)
+    plan = MigrationPlan(VectorStore(sdir), _Corpus(), _Embedder(2), 2)
+    plan.begin()
+    plan.migrate_unit(0)
+    # an append lands mid-sweep, stamped by the OLD serving model
+    new_ids = _append_gen(sdir, 15, step=1)
+    plan = MigrationPlan(VectorStore(sdir), _Corpus(), _Embedder(2), 2)
+    assert plan.begin()["action"] == "resumed"
+    assert plan.pending_units() == [1, 2]
+    assert plan.complete() is None                 # units still pending
+    for u in (1, 2):
+        plan.migrate_unit(u)
+    assert plan.complete()["action"] == "completed"
+    store = VectorStore(sdir)
+    assert store.model_steps() == [2]
+    assert store.num_vectors == 100 + 15
+    got = set(int(i) for i in store.load_all()[0])
+    assert set(int(i) for i in new_ids) <= got
+
+
+# ---------------------------------------------------------------------------
+# crash-anywhere fault matrix
+# ---------------------------------------------------------------------------
+
+# every check-point of the sweep: per-shard re-embed writes (0 = first
+# base shard, 1 = mid-unit-0 with a torn dir behind it, 2 = the gen unit
+# after the base flip committed — a dual-stamp store), and every atomic
+# flip (dump call 0 = begin's record, 1 = the base-unit flip, 2 = the gen
+# flip, 3 = complete's stamp flip; persistent so the retry wrapper can't
+# absorb them)
+_CRASH_PLANS = [
+    "migrate_write:io_error:0",
+    "migrate_write:io_error:1",
+    "migrate_write:io_error:2",
+    "migrate_swap_dump:io_error:0:*",
+    "migrate_swap_dump:io_error:1:*",
+    "migrate_swap_dump:io_error:2:*",
+    "migrate_swap_dump:io_error:3:*",
+]
+
+
+@pytest.mark.parametrize("plan_txt", _CRASH_PLANS)
+def test_crash_anywhere_leaves_serveable_store_and_resumes(
+        tmp_path, mesh, plan_txt):
+    sdir = str(tmp_path / "store")
+    _build_store(sdir)
+    corpus = _Corpus()
+    faults.install(faults.FaultPlan.parse(plan_txt, seed=0))
+    with pytest.raises(IOError):
+        migrate_store(VectorStore(sdir), corpus, _Embedder(2), 2)
+    faults.install(faults.FaultPlan())
+    # the store reopens serveable on exactly one side of the torn flip:
+    # whatever stamp mix it holds, every page still self-hits through the
+    # stamp-routed query path
+    cold = VectorStore(sdir)
+    assert cold.num_vectors == 100
+    assert set(cold.model_steps()) <= {1, 2}
+    svc = _service(cold, mesh, corpus=corpus)
+    _assert_all_self_hits(svc, range(0, 100, 7), f"after {plan_txt}")
+    svc.close()
+    # and the sweep RESUMES from the manifest to completion
+    out = migrate_store(VectorStore(sdir), corpus, _Embedder(2), 2)
+    assert out["action"] in ("migrated", "noop")
+    store = VectorStore(sdir)
+    assert store.model_step == 2 and store.model_steps() == [2]
+    assert store.migration is None
+    svc = _service(store, mesh, corpus=corpus)
+    _assert_all_self_hits(svc, range(0, 100, 7), f"resumed {plan_txt}")
+    svc.close()
+
+
+def test_transient_swap_fault_absorbed_by_retry(tmp_path):
+    """A once-off io_error on the flip dump is absorbed by the shared
+    retry wrapper — the sweep completes without surfacing it."""
+    sdir = str(tmp_path / "store")
+    _build_store(sdir)
+    faults.install(faults.FaultPlan.parse("migrate_swap_dump:io_error:1",
+                                          seed=0))
+    out = migrate_store(VectorStore(sdir), _Corpus(), _Embedder(2), 2)
+    assert out["action"] == "migrated" and out["completed"]
+    assert faults.counters().get("injected_migrate_swap_dump_io_error") == 1
+    assert faults.counters().get("retry_migrate_swap_dump", 0) >= 1
+    assert VectorStore(sdir).model_step == 2
+
+
+def test_corrupted_flip_file_quarantines_main_manifest(tmp_path):
+    """Post-fsync damage to the flip's tmp file (NOT a crash — the bytes
+    were torn after the fault window) lands a torn MAIN manifest: reopen
+    quarantines it with a clear restore-me error, never a JSON traceback,
+    and counts it. The damage must hit the LAST flip (complete()'s) — an
+    earlier torn manifest is simply overwritten by the next unit's good
+    dump, which is itself a recovery property."""
+    sdir = str(tmp_path / "store")
+    _build_store(sdir)
+    faults.install(faults.FaultPlan.parse("migrate_swap_file:truncate:3",
+                                          seed=0))
+    migrate_store(VectorStore(sdir), _Corpus(), _Embedder(2), 2)
+    faults.install(faults.FaultPlan())
+    with pytest.raises(ValueError, match="corrupt"):
+        VectorStore(sdir)
+    assert os.path.exists(os.path.join(sdir, "manifest.json.quarantined"))
+    assert faults.counters().get("quarantined_manifests") == 1
+
+
+# ---------------------------------------------------------------------------
+# dual-stamp serving
+# ---------------------------------------------------------------------------
+
+def test_dual_stamp_serving_routes_each_shard_through_its_tower(
+        tmp_path, mesh):
+    sdir = str(tmp_path / "store")
+    _build_store(sdir)
+    corpus = _Corpus()
+    svc = _service(VectorStore(sdir), mesh, corpus=corpus)
+    svc.begin_migration(("tower", 2), 2)
+    plan = MigrationPlan(VectorStore(sdir), corpus, _Embedder(2), 2)
+    plan.begin()
+    plan.migrate_unit(0)                 # base re-stamped, gen still old
+    info = svc.refresh()
+    view = svc._view
+    assert view.steps == [1, 2]
+    assert sorted(set(view.shard_steps)) == [1, 2]
+    # one stamp per STAGED SHARD, never mixed within one — and the view's
+    # stamps agree with the store's recorded per-entry stamps
+    assert view.shard_steps == [view.store.entry_step(e)
+                                for e in view.entries]
+    mig = info.get("migration")
+    assert mig and mig["from_step"] == 1 and mig["to_step"] == 2
+    assert mig["stamps_serving"] == [1, 2]
+    # every page self-hits: base pages through tower 2, gen pages through
+    # tower 1 — a cross-stamp scoring would randomize these top-1s
+    _assert_all_self_hits(svc, range(0, 100, 5), "resident dual-stamp")
+    svc.close()
+    # the streaming path (no HBM residency) routes identically
+    svc2 = _service(VectorStore(sdir), mesh, corpus=corpus, hbm=0.0)
+    _assert_all_self_hits(svc2, range(0, 100, 5), "streaming dual-stamp")
+    svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# the maintenance pillar, live under a query+append hammer
+# ---------------------------------------------------------------------------
+
+def test_pillar_migrates_live_service_under_hammer(tmp_path, mesh):
+    """request_migration -> run_once passes on a SERVING store with a
+    3-thread query hammer and an append landing mid-sweep: zero request
+    errors, recall@10 >= 0.95 throughout, per-pass view swaps, gauges and
+    events emitted, and the completing refresh adopts the new tower."""
+    sdir = str(tmp_path / "store")
+    _build_store(sdir, nbase=3, gen_rows=(20,))    # 140 rows
+    corpus = _Corpus()
+    svc = _service(VectorStore(sdir), mesh, corpus=corpus)
+    maint = svc.start_maintenance(threads=False)
+    emb2 = _Embedder(2, mesh=mesh)
+    maint.request_migration(2, corpus, emb2)
+    assert svc._towers == {2: ("tower", 2)}        # dual-stamp armed now
+
+    stop = threading.Event()
+    stats = {"total": 0, "hit10": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def hammer(ti):
+        rng = np.random.default_rng(ti)
+        while not stop.is_set():
+            pid = int(rng.integers(0, 140))
+            try:
+                hits = svc.search(f"page {pid}", k=10)
+                ok = pid in [int(r["page_id"]) for r in hits]
+            except Exception:
+                with lock:
+                    stats["errors"] += 1
+                continue
+            with lock:
+                stats["total"] += 1
+                stats["hit10"] += int(ok)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(3)]
+    for t in threads:
+        t.start()
+    passes, appended = [], False
+    try:
+        for _ in range(32):
+            # let the hammer sample THIS stamp mix before the next flip —
+            # the sweep itself is sub-second on a toy store
+            time.sleep(0.2)
+            out = maint.run_once().get("migrate")
+            if out is None:
+                break
+            passes.append(out)
+            if out.get("action") == "completed":
+                break
+            if not appended:                        # mid-sweep append
+                _append_gen(sdir, 10, step=1, corpus=corpus)
+                appended = True
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+    assert appended and passes
+    assert passes[-1]["action"] == "completed"
+    assert passes[-1]["from_step"] == 1 and passes[-1]["to_step"] == 2
+    migrating = [p for p in passes if p["action"] == "migrating"]
+    assert migrating and all("refresh_swap_ms" in p for p in migrating)
+    assert stats["errors"] == 0, f"hammer saw {stats['errors']} errors"
+    assert stats["total"] > 50
+    recall = stats["hit10"] / stats["total"]
+    assert recall >= 0.95, f"recall@10 {recall:.3f} through migration"
+
+    store = VectorStore(sdir)
+    assert store.model_step == 2 and store.model_steps() == [2]
+    assert store.num_vectors == 150
+    # the completing refresh adopted the new tower and dropped the old
+    assert svc.embedder.params == ("tower", 2)
+    assert svc._towers == {}
+    assert svc._view.steps == [2]
+    reg = maint.registry
+    assert reg.gauge("migrate.generations_done").value >= 1
+    assert reg.gauge("migrate.pages_per_s").value > 0
+    assert reg.counter("maintenance.migrations").value == 1
+    names = [e["event"] for e in reg.events()]
+    assert "migration_started" in names
+    assert "migration_generation_done" in names
+    assert "migration_complete" in names
+    _assert_all_self_hits(svc, range(0, 150, 11), "post-migration")
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# result-cache stamp pin (the key-composition bug this PR fixes)
+# ---------------------------------------------------------------------------
+
+class _PinCorpus:
+    """page 7's text IS the probe query: post-migration its re-embedded
+    vector equals the step-2 query vector, so the correct answer flips
+    from the planted page 3 to page 7 — a stale cached result is
+    observably wrong, not merely old."""
+    QUERY = "the zipf head probe"
+
+    def page_text(self, i):
+        return self.QUERY if int(i) == 7 else f"page {int(i)}"
+
+
+def test_result_cache_key_carries_model_stamp(tmp_path, mesh):
+    sdir = str(tmp_path / "store")
+    corpus = _PinCorpus()
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    store.ensure_model_step(1)
+    vecs = _Embedder(1).embed_texts(
+        [corpus.page_text(i) for i in range(SHARD)])
+    vecs[3] = _vec(corpus.QUERY, 1)       # planted step-1 top-1
+    vecs[7] = _vec("decoy", 1)            # page 7 does NOT match at step 1
+    store.write_shard(0, np.arange(SHARD, dtype=np.int64), vecs)
+    svc = _service(VectorStore(sdir), mesh, corpus=corpus,
+                   result_cache=True)
+    q = corpus.QUERY
+    first = svc.search(q, k=5)
+    assert int(first[0]["page_id"]) == 3
+    assert svc.search(q, k=5) == first and svc.result_cache_hits == 1
+    key1 = svc._result_cache_key(q, 5, None)
+    assert (key1[3] >> 32) == 1           # serving stamp in the high word
+
+    svc.begin_migration(("tower", 2), 2)
+    out = migrate_store(VectorStore(sdir), corpus, _Embedder(2), 2)
+    assert out["completed"]
+    svc.refresh()
+    after = svc.search(q, k=5)
+    # the stamp (and the epoch-folded generation) changed: the cached
+    # step-1 answer is unreachable, and the fresh scan finds page 7
+    assert svc.result_cache_hits == 1 and svc.result_cache_misses == 2
+    assert int(after[0]["page_id"]) == 7
+    key2 = svc._result_cache_key(q, 5, None)
+    assert (key2[3] >> 32) == 2
+    assert key1 != key2
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# socket fleet: one connection through the whole migration
+# ---------------------------------------------------------------------------
+
+def test_socket_client_rides_one_connection_through_migration(
+        tmp_path, mesh):
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    from dnn_page_vectors_tpu.infer.transport import SocketSearchClient
+    sdir = str(tmp_path / "store")
+    _build_store(sdir)
+    corpus = _Corpus()
+    svc = _service(VectorStore(sdir), mesh, corpus=corpus)
+    maint = svc.start_maintenance(threads=False)
+    srv = serve_in_background(svc)
+    client = SocketSearchClient(srv.host, srv.port)
+    try:
+        assert int(client.search("page 5", k=5)[0]["page_id"]) == 5
+        maint.request_migration(2, corpus, _Embedder(2, mesh=mesh))
+        done = False
+        for _ in range(16):
+            out = maint.run_once().get("migrate")
+            if out is None or out.get("action") == "completed":
+                done = out is not None
+                break
+            # mid-sweep, the SAME connection keeps answering correctly
+            # across both stamps — no worker restart, no reconnect
+            for pid in (5, 45, 85, 95):
+                assert int(client.search(f"page {pid}",
+                                         k=5)[0]["page_id"]) == pid
+        assert done
+        assert VectorStore(sdir).model_step == 2
+        for pid in (5, 45, 85, 95):
+            assert int(client.search(f"page {pid}",
+                                     k=5)[0]["page_id"]) == pid
+    finally:
+        client.close()
+        srv.close()
+        svc.close()
