@@ -138,7 +138,15 @@ _GATE_SKIP = {"vs_baseline", "attempts", "slo_p99_target_ms",
               # keys (migrate_pages_per_s higher-is-better;
               # migration_sweep_seconds, migration_swap_ms,
               # serve_p99_during_migration_ms lower-is-better) all gate
-              "migration_units", "post_migration_model_step"}
+              "migration_units", "post_migration_model_step",
+              # filtered_serve protocol constants (store geometry, the
+              # workload's distinct-query count) — the phase's MEASURED
+              # keys (filtered_serve_qps_at_p99_*, filtered_recall_*,
+              # filtered_ivf_recall_* higher-is-better; filtered_scan_
+              # bytes_per_query_* and the s10 bytes ratio lower-is-
+              # better via the "_bytes" token) all gate
+              "filtered_store_rows", "filtered_dim", "filtered_k",
+              "filtered_distinct"}
 _LOWER_IS_BETTER = ("_ms", "seconds", "imbalance", "error", "_bytes",
                     "lint_", "shed", "hedge", "_us_per_", "dip")
 
@@ -2446,6 +2454,214 @@ def run_cache_worker() -> None:
     print(json.dumps(rec), flush=True)
 
 
+def run_filtered_worker() -> None:
+    """filtered_serve phase: CPU-honest pricing of predicate-filtered
+    retrieval (docs/ANN.md "Filtered retrieval"). A synthetic store is
+    built with a packed attribute word per row laid out so three
+    predicates hit fixed selectivities — `lang==0` keeps 1/2 the rows
+    (s50), `site in {0}` keeps 1/10 (s10), `recency>=3` keeps 1/100
+    (s1). Each arm plus the unfiltered baseline is priced through the
+    real serving path (find_qps_at_p99 over a 100%%-filtered workload
+    mix), and the exact filtered scan's per-query byte count is recorded
+    per arm: the s10 arm's bytes-vs-unfiltered ratio is the <=0.3x
+    acceptance gate. An IVF index over the same store prices the
+    predicate-intersected posting path: recall@10 vs the exact
+    post-filter oracle at each selectivity (the >=0.95 contract)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import shutil
+
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from dnn_page_vectors_tpu.config import get_config
+    from dnn_page_vectors_tpu.index import attrs as attrs_mod
+    from dnn_page_vectors_tpu.index.ivf import IVFIndex
+    from dnn_page_vectors_tpu.infer.partition_host import MeshEmbedder
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+    from dnn_page_vectors_tpu.loadgen import find_qps_at_p99, make_workload
+
+    dim = int(os.environ.get("BENCH_FILTERED_DIM", "64"))
+    shard_rows = int(os.environ.get("BENCH_FILTERED_SHARD_ROWS", "16384"))
+    n_shards = int(os.environ.get("BENCH_FILTERED_SHARDS", "4"))
+    trial_s = float(os.environ.get("BENCH_FILTERED_TRIAL_S", "1.5"))
+    p99_ms = float(os.environ.get("BENCH_FILTERED_P99_MS", "200"))
+    iters = int(os.environ.get("BENCH_FILTERED_ITERS", "2"))
+    start_qps = float(os.environ.get("BENCH_FILTERED_START_QPS", "16"))
+    reps = max(1, int(os.environ.get("BENCH_FILTERED_REPS", "2")))
+    distinct = int(os.environ.get("BENCH_FILTERED_DISTINCT", "32"))
+    kq = 10
+    rows = shard_rows * n_shards
+    wdir = "/tmp/dnn_page_vectors_tpu_bench/filtered"
+    sdir = os.path.join(wdir, "store")
+    _stamp(f"filtered phase: building {rows}-row attributed store "
+           f"({n_shards} shards, dim {dim})")
+    rng = np.random.default_rng(0)
+    shutil.rmtree(wdir, ignore_errors=True)
+    store = VectorStore(sdir, dim=dim, shard_size=shard_rows)
+    store.init_attrs()
+    all_ids = np.arange(rows, dtype=np.int64)
+    # deterministic attribute layout -> pinned selectivities (see docstring)
+    words = attrs_mod.pack_words(
+        lang=(all_ids % 2).astype(np.uint32),
+        site=(all_ids % 10).astype(np.uint32),
+        recency=np.where(all_ids % 100 == 0, 3, 0).astype(np.uint32))
+    for si in range(n_shards):
+        lo, hi = si * shard_rows, (si + 1) * shard_rows
+        v = rng.standard_normal((shard_rows, dim)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, all_ids[lo:hi], v, attrs=words[lo:hi])
+    store = VectorStore(sdir)
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+    qvs = rng.standard_normal((distinct, dim)).astype(np.float32)
+    qvs /= np.linalg.norm(qvs, axis=1, keepdims=True)
+    qnames = [f"q{i}" for i in range(distinct)]
+    qvec = {name: qvs[i:i + 1] for i, name in enumerate(qnames)}
+
+    def _stub_embed(queries):
+        return np.concatenate([qvec[q] for q in queries], axis=0)
+
+    class _StubCorpus:
+        def page_text(self, i):
+            return f"page {i}"
+
+    rec = {"filtered_store_rows": rows, "filtered_dim": dim,
+           "filtered_k": kq, "filtered_distinct": distinct}
+    arms = (("unfiltered", None),
+            ("s50", "lang==0"),
+            ("s10", "site in {0}"),
+            ("s1", "recency>=3"))
+    cfg = get_config("cdssm_toy", {
+        "model.out_dim": dim,
+        "obs.window_s": trial_s,
+        # the cache would absorb the repeats and price the probe, not
+        # the filtered scan — this phase wants the scan
+        "serve.result_cache": False})
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                        preload_hbm_gb=4.0)
+    svc._embed_queries_cached = _stub_embed
+    svc.corpus = _StubCorpus()
+    # exact post-filter oracle over the DEQUANTIZED store rows (the
+    # store holds fp16; comparing against the fp32 originals would
+    # charge quantization error to the filter)
+    deq = np.concatenate([store._load_entry(e)[1] for e in store.shards()])
+    deq = np.asarray(deq, np.float32)
+    scores = qvs @ deq.T
+    try:
+        svc.search(qnames[0], k=kq)            # warm every compiled shape
+        for label, pred_text in arms:
+            pred = (attrs_mod.Predicate.parse(pred_text)
+                    if pred_text else None)
+            # per-query scan bytes on the exact path (n=1 so shared
+            # gathers are not amortized across a batch)
+            probe = 8
+            scan = 0
+            for i in range(probe):
+                _, ids1, sb = svc._topk_view(svc._view, qvs[i:i + 1], 1,
+                                             kq, None, predicate=pred)
+                scan += int(sb)
+            rec[f"filtered_scan_bytes_per_query_{label}"] = scan // probe
+            if pred is not None:
+                keep = pred.matches(words)
+                hits = 0
+                for i in range(probe):
+                    sc = scores[i].copy()
+                    sc[~keep] = -np.inf
+                    oracle = np.argsort(-sc)[:kq]
+                    _, ids1, _ = svc._topk_view(svc._view, qvs[i:i + 1],
+                                                1, kq, None,
+                                                predicate=pred)
+                    hits += len(set(int(x) for x in ids1[0] if x >= 0)
+                                & set(int(o) for o in oracle))
+                rec[f"filtered_recall_{label}"] = round(
+                    hits / (probe * kq), 4)
+            scen = ((label, pred_text, 1.0),) if pred_text else None
+            wl = make_workload("poisson", seed=0, distinct=distinct,
+                               profile=((kq, None, 1.0),),
+                               filter_scenarios=scen)
+            _stamp(f"filtered arm={label}: searching qps @ "
+                   f"p99<{p99_ms:.0f}ms (best of {reps})")
+            best = 0.0
+            for _ in range(reps):
+                rep = find_qps_at_p99(
+                    svc, wl, qnames, p99_target_ms=p99_ms,
+                    start=start_qps, iters=iters, duration_s=trial_s,
+                    warmup_s=0.5, workers=16)
+                best = max(best, rep["qps_at_p99"])
+            rec[f"filtered_serve_qps_at_p99_{label}"] = round(best, 2)
+            _stamp(f"filtered arm={label}: {best:.1f} qps, "
+                   f"{rec[f'filtered_scan_bytes_per_query_{label}']} "
+                   f"scan B/query")
+    finally:
+        svc.close()
+    base = rec.get("filtered_scan_bytes_per_query_unfiltered") or 0
+    s10 = rec.get("filtered_scan_bytes_per_query_s10")
+    if base and s10 is not None:
+        rec["filtered_scan_bytes_ratio_s10"] = round(s10 / base, 4)
+        _stamp(f"filtered s10 scan ratio: "
+               f"x{rec['filtered_scan_bytes_ratio_s10']:.3f} of the "
+               f"unfiltered exact bytes (gate <=0.3)")
+    # IVF predicate intersection: recall@10 vs the exact post-filter
+    # oracle with the predicate applied BEFORE ADC/payload gather
+    _stamp("filtered ivf: building IVF index for the intersected path")
+    idx = IVFIndex.build(store, mesh, nlist=64, iters=4, seed=0)
+    nprobe = int(os.environ.get("BENCH_FILTERED_NPROBE", "16"))
+    for label, pred_text in arms[1:]:
+        pred = attrs_mod.Predicate.parse(pred_text)
+        keep = pred.matches(words)
+        sf, if_, st = idx.search(qvs[:8], kq, nprobe=nprobe,
+                                 predicate=pred)
+        hits = 0
+        for i in range(8):
+            sc = scores[i].copy()
+            sc[~keep] = -np.inf
+            oracle = np.argsort(-sc)[:kq]
+            hits += len(set(int(x) for x in if_[i] if x >= 0)
+                        & set(int(o) for o in oracle))
+        rec[f"filtered_ivf_recall_{label}"] = round(hits / (8 * kq), 4)
+    _stamp(f"filtered ivf recall@{kq}: "
+           + ", ".join(f"{lab}={rec[f'filtered_ivf_recall_{lab}']:.2f}"
+                       for lab, _ in arms[1:]))
+    print(json.dumps(rec), flush=True)
+
+
+def _run_filtered() -> dict:
+    """Run the filtered_serve phase in a CPU subprocess and return its
+    keys — merged into every record like the cache and net phases, so
+    the predicate-pricing numbers re-seed the baseline with no TPU."""
+    if os.environ.get("BENCH_FILTERED", "1") == "0":
+        return {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--filtered-worker"],
+            capture_output=True, text=True,
+            timeout=int(os.environ.get("BENCH_FILTERED_TIMEOUT_S", "600")),
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "filtered_store_rows" in rec:
+                return rec
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"filtered_error":
+                (" | ".join(tail[-3:]) if tail
+                 else f"rc={proc.returncode}")[:300]}
+    except subprocess.TimeoutExpired:
+        return {"filtered_error": "filtered worker timed out"}
+    except Exception as e:  # noqa: BLE001 — the phase never costs a round
+        return {"filtered_error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def _run_cache() -> dict:
     """Run the result-cache A/B phase in a CPU subprocess and return its
     keys — merged into every record like the partitioned and net phases,
@@ -2631,6 +2847,7 @@ def main() -> None:
     rec.update(_run_partitioned())
     rec.update(_run_net())
     rec.update(_run_cache())
+    rec.update(_run_filtered())
     print(json.dumps(rec))
 
 
@@ -2641,6 +2858,7 @@ def _finalize(rec: dict) -> None:
     rec.update(_run_partitioned())
     rec.update(_run_net())
     rec.update(_run_cache())
+    rec.update(_run_filtered())
     prev = _previous_bench_record()
     _, regs = _regression_gate(rec, prev)
     rec["regressions"] = regs
@@ -2657,5 +2875,7 @@ if __name__ == "__main__":
         run_net_worker()
     elif "--cache-worker" in sys.argv:
         run_cache_worker()
+    elif "--filtered-worker" in sys.argv:
+        run_filtered_worker()
     else:
         main()

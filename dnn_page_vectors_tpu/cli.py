@@ -152,8 +152,28 @@ def main(argv=None) -> None:
                     help="append: comma-separated existing page ids to "
                          "RE-EMBED into the new generation (old rows "
                          "tombstoned automatically)")
+    ap.add_argument("--attrs", nargs="+", default=None, metavar="K=V",
+                    help="append: stamp every appended/updated row with "
+                         "these attributes — lang=<0-255>, site=<string "
+                         "or bucket 0-65535>, recency=<band 0-15> — "
+                         "packed into one per-row attribute word "
+                         "(docs/ANN.md 'Filtered retrieval'). Refuses on "
+                         "a store with no attribute table unless "
+                         "--init-attrs is also given")
+    ap.add_argument("--init-attrs", dest="init_attrs", action="store_true",
+                    help="append: initialize the store's attribute table "
+                         "first (records the versioned bit-field layout "
+                         "in the manifest; shards written before it read "
+                         "as all-zero words)")
     ap.add_argument("--query", default=None,
                     help="search: free-text query to embed and retrieve for")
+    ap.add_argument("--filter", dest="filter_expr", default=None,
+                    metavar="EXPR",
+                    help="search: attribute predicate every result must "
+                         "match — 'lang==X', 'site in {a,b}', "
+                         "'recency>=band', '&'-joined conjunctions "
+                         "(docs/ANN.md 'Filtered retrieval'); applies to "
+                         "--query, --queries, and --interactive")
     ap.add_argument("--queries", default=None, metavar="FILE",
                     help="search: batch mode — one query per line, routed "
                          "through search_many (bucket-filling vectorized "
@@ -263,6 +283,12 @@ def main(argv=None) -> None:
                     choices=["round_robin", "least_loaded"],
                     help="loadtest: client-side balancing policy across "
                          "--front-ends (seeded by --seed so runs replay)")
+    ap.add_argument("--filters", dest="lt_filters", action="store_true",
+                    help="loadtest: mix seeded filtered queries into the "
+                         "workload (per-scenario predicate profiles over "
+                         "the Zipf repeat distribution, docs/ANN.md "
+                         "'Filtered retrieval'); the report gains a "
+                         "per-scenario qps/p99 block")
     # -- partition-worker (docs/SERVING.md "Network front end") ------------
     ap.add_argument("--connect", default=None, metavar="HOST:PORT",
                     help="partition-worker: the front end's WorkerGateway "
@@ -743,10 +769,24 @@ def main(argv=None) -> None:
                 if x.strip()]
         upd = [int(x) for x in (args.update_ids or "").split(",")
                if x.strip()]
+        attr_word = None
+        if args.init_attrs:
+            store.init_attrs()
+        if args.attrs:
+            from dnn_page_vectors_tpu.index import attrs as attrs_mod
+            try:
+                attr_word = attrs_mod.parse_attr_assignments(args.attrs)
+            except attrs_mod.FilterError as e:
+                raise SystemExit(f"bad --attrs: {e}")
+            if not store.attrs_enabled:
+                raise SystemExit(
+                    f"store at {store_dir} has no attribute table; pass "
+                    "--init-attrs once to create it (older shards then "
+                    "read as all-zero attribute words), or drop --attrs")
         with maybe_profile(args.profile, cfg.workdir):
             stats = append_corpus(
                 embedder, trainer.corpus, store, tombstone=tomb,
-                update_ids=upd,
+                update_ids=upd, attrs=attr_word,
                 log=MetricsLogger(cfg.workdir, echo=False,
                                   registry=telemetry.default_registry()))
         index_info = None
@@ -818,7 +858,8 @@ def main(argv=None) -> None:
             # result line per query in input order
             with open(args.queries) as f:
                 queries = [ln.strip() for ln in f if ln.strip()]
-            results = svc.search_many(queries, k=k)
+            results = svc.search_many(queries, k=k,
+                                      filters=args.filter_expr)
             for query, res in zip(queries, results):
                 print(json.dumps({"query": query, "results": res}),
                       flush=True)
@@ -851,13 +892,17 @@ def main(argv=None) -> None:
                                      sort_keys=True), flush=True)
                     continue
                 print(json.dumps({"query": query,
-                                  "results": svc.search(query, k=k)}),
+                                  "results": svc.search(
+                                      query, k=k,
+                                      filters=args.filter_expr)}),
                       flush=True)
             svc.close()
         else:
             print(json.dumps({"query": args.query,
                               "degraded": svc.degraded,
-                              "results": svc.search(args.query, k=k)}))
+                              "results": svc.search(
+                                  args.query, k=k,
+                                  filters=args.filter_expr)}))
     elif args.command == "loadtest":
         # SLO harness (docs/SERVING.md "SLO methodology"): replay a seeded
         # traffic shape against a live micro-batched service and
@@ -975,8 +1020,18 @@ def main(argv=None) -> None:
                                      seed=args.seed))
         distinct = max(1, args.distinct)
         queries = [trainer.corpus.query_text(i) for i in range(distinct)]
+        scen = None
+        if args.lt_filters:
+            # seeded filtered-query mix (docs/ANN.md "Filtered
+            # retrieval"): the default scenario predicates all match the
+            # all-zero attribute word, so the filtered path exercises
+            # even on a store whose shards predate init_attrs()
+            from dnn_page_vectors_tpu.loadgen.workload import (
+                DEFAULT_FILTER_SCENARIOS)
+            scen = DEFAULT_FILTER_SCENARIOS
         wl = make_workload(args.shape, seed=args.seed, distinct=distinct,
-                           profile=((k, None, 1.0),))
+                           profile=((k, None, 1.0),),
+                           filter_scenarios=scen)
         maint = None
         if args.mutate_every and args.mutate_mode == "maintain":
             # maintenance under fire (docs/MAINTENANCE.md): alternate a
@@ -1034,6 +1089,13 @@ def main(argv=None) -> None:
             if n_fe > 1:
                 report["front_ends"] = n_fe
                 report["balance_policy"] = args.balance
+        if args.lt_filters:
+            # per-scenario qps/p99 rides every trial record
+            # (loadgen/driver.py "filter_scenarios"); the headline marker
+            # here just says the mix was armed
+            report["filters"] = [
+                {"scenario": name, "predicate": pred, "weight": w}
+                for name, pred, w in scen]
         if cfg.serve.result_cache:
             # result-cache block (docs/SERVING.md "Result cache"): run
             # totals straight off the registry — per-trial deltas ride

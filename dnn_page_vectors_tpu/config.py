@@ -318,6 +318,18 @@ class ServeConfig:
     # one hot set. Requires serve.result_cache; mixed fleets where one
     # side never negotiated the flag degrade to local-only caching.
     result_cache_fleet: bool = False
+    # Filtered retrieval (docs/ANN.md "Filtered retrieval"): accept and
+    # serve per-query attribute predicates (`lang==X`, `site in {...}`,
+    # `recency>=band`, '&'-conjunctions) — advertised/confirmed per
+    # connection as FLAG_FILTERS, exactly like wire compression. False =
+    # this end never negotiates the flag: a gateway serves filtered
+    # slices from its local view, a client raises on a filtered call.
+    filters: bool = True
+    # Under-filled-probe escalation: when a filtered IVF probe set yields
+    # fewer than k matching rows, the probe count multiplies by this
+    # factor and the scan re-runs (ivf.filter_escalations counter) until
+    # k fills or every list drains. <= 1 disables escalation.
+    filter_escalate: float = 4.0
     # Self-healing fleet (docs/ROBUSTNESS.md "Network failure model").
     # A partition worker that loses its gateway connection (EOF, torn
     # frame, socket error) re-dials with exponential backoff + jitter and

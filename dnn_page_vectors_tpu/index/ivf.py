@@ -158,6 +158,7 @@ class IVFIndex:
         self._meta = {s["index"]: s for s in manifest["shards"]}
         self._raw: Dict[int, tuple] = {}           # lazy mmap cache
         self._codes: Dict[int, np.ndarray] = {}    # lazy PQ code mmaps
+        self._attrs: Dict[int, np.ndarray] = {}    # lazy attr-word arrays
         self._dev_centroids = None
         self.pq = pq                               # OPQ+PQ codec or None
         self._hot = None                           # stage_hot device state
@@ -169,7 +170,8 @@ class IVFIndex:
         self.list_sizes = sizes
         self.stats = {"searches": 0, "lists_scanned": 0,
                       "candidates_reranked": 0, "gather_bytes": 0,
-                      "reranked_rows": 0, "hot_rows_scored": 0}
+                      "reranked_rows": 0, "hot_rows_scored": 0,
+                      "filter_escalations": 0}
         # windowed per-list popularity table (docs/ANN.md "Popularity
         # tiering"): every search adds its probed-list histogram here,
         # and stage_hot ranks by it — then HALVES it, so the resident
@@ -674,14 +676,27 @@ class IVFIndex:
                              self._meta[sidx]["pqc"]), mmap_mode="r")
         return arr
 
-    def _gather_codes(self, cents: np.ndarray):
+    def _shard_attrs(self, sidx: int) -> np.ndarray:
+        """One shard's packed attribute words (uint32 [count]; zeros for
+        shards written before the store's attribute table existed) —
+        the filtered-retrieval prefilter's input (index/attrs.py)."""
+        arr = self._attrs.get(sidx)
+        if arr is None:
+            arr = self._attrs[sidx] = self.store.load_attrs(
+                self._entries[sidx])
+        return arr
+
+    def _gather_codes(self, cents: np.ndarray, predicate=None):
         """Candidate block for one probed-list union at CODE width: m
         bytes per row off the mmap'd pqc files instead of the stored row
         width. Returns (codes [C, m] u8, page_ids [C] i64, cand_cent [C]
         i32, src_shard [C] i32, src_row [C] i32) — the source coordinates
         let the exact re-rank fetch only the ADC survivors' rows later.
         Tombstoned rows get centroid -2 (matches no probed list), the
-        same dead-slot convention as _gather."""
+        same dead-slot convention as _gather. A `predicate`
+        (index/attrs.py) prefilters each shard's posting rows against its
+        attribute words BEFORE the code gather, so a filtered query moves
+        selectivity-proportional bytes instead of post-filtering top-k."""
         c_parts, i_parts, n_parts, sh_parts, rw_parts = [], [], [], [], []
         for sidx in sorted(self._postings):
             order, offsets = self._postings[sidx]
@@ -690,11 +705,16 @@ class IVFIndex:
             if lens.sum() == 0:
                 continue
             take = np.concatenate(rows)
+            cent = np.repeat(np.asarray(cents, np.int32), lens)
+            if predicate is not None:
+                keep = predicate.matches(self._shard_attrs(sidx)[take])
+                if not keep.any():
+                    continue
+                take, cent = take[keep], cent[keep]
             ids, _, _ = self._shard_raw(sidx)
             taken_ids = np.asarray(ids[take], np.int64)
             c_parts.append(np.asarray(self._codes_raw(sidx)[take]))
             i_parts.append(taken_ids)
-            cent = np.repeat(np.asarray(cents, np.int32), lens)
             n_parts.append(np.where(taken_ids >= 0, cent, np.int32(-2)))
             sh_parts.append(np.full((take.shape[0],), sidx, np.int32))
             rw_parts.append(take.astype(np.int32))
@@ -767,19 +787,28 @@ class IVFIndex:
             self._hot = None
             return {"hot_lists": 0, "hot_rows": 0, "hot_bytes": 0,
                     "hot_by_popularity": by_popularity}
+        # per-row attribute words ride along so a filtered query can mask
+        # resident rows ON DEVICE (index/attrs.py matches_device) instead
+        # of forcing hot lists back onto the host gather path
+        words = np.zeros((n,), np.uint32)
+        for sidx in np.unique(sh):
+            m_ = sh == sidx
+            words[m_] = self._shard_attrs(int(sidx))[rw[m_]]
         pad = _bucket(n, lo=512)
         if pad > n:
             codes = np.concatenate(
                 [codes, np.zeros((pad - n, self.pq.m), np.uint8)])
             cent = np.concatenate([cent, np.full((pad - n,), -1, np.int32)])
+            words = np.concatenate([words, np.zeros((pad - n,), np.uint32)])
         self._hot = {
             "lists": resident, "rows": n, "bytes": used,
             "codes": jnp.asarray(codes), "cent": jnp.asarray(cent),
+            "attrs": jnp.asarray(words),
             "chunk": min(2048, pad), "ids": ids, "shard": sh, "row": rw}
         return {"hot_lists": int(resident.sum()), "hot_rows": n,
                 "hot_bytes": used, "hot_by_popularity": by_popularity}
 
-    def _gather(self, cents: np.ndarray):
+    def _gather(self, cents: np.ndarray, predicate=None):
         """Candidate block for one probed-list union: rows of every listed
         centroid across every shard, at STORED width (int8 codes / fp16
         rows straight off the mmap — the rerank matmul widens on device).
@@ -787,7 +816,10 @@ class IVFIndex:
         cand_cent [C] i32). Tombstoned rows (id -1 after the store's
         read-time masking, docs/UPDATES.md) get centroid -2 — matching no
         probed list — so a dead vector can never OCCUPY a top-k slot, not
-        merely be filtered after winning one."""
+        merely be filtered after winning one. A `predicate`
+        (index/attrs.py) drops non-matching rows against the shard's
+        attribute words BEFORE the row gather — the filtered path's
+        scan-byte reduction happens exactly here."""
         v_parts, s_parts, i_parts, c_parts = [], [], [], []
         for sidx in sorted(self._postings):
             order, offsets = self._postings[sidx]
@@ -796,13 +828,18 @@ class IVFIndex:
             if lens.sum() == 0:
                 continue
             take = np.concatenate(rows)
+            cent = np.repeat(cents.astype(np.int32), lens)
+            if predicate is not None:
+                keep = predicate.matches(self._shard_attrs(sidx)[take])
+                if not keep.any():
+                    continue
+                take, cent = take[keep], cent[keep]
             ids, vecs, scl = self._shard_raw(sidx)
             taken_ids = np.asarray(ids[take], np.int64)
             v_parts.append(np.asarray(vecs[take]))
             i_parts.append(taken_ids)
             if scl is not None:
                 s_parts.append(np.asarray(scl[take]))
-            cent = np.repeat(cents.astype(np.int32), lens)
             c_parts.append(np.where(taken_ids >= 0, cent, np.int32(-2)))
         if not v_parts:
             return (np.zeros((0, self.store.dim), np.float16), None,
@@ -812,22 +849,68 @@ class IVFIndex:
                 np.concatenate(i_parts), np.concatenate(c_parts))
 
     def search(self, qvecs: np.ndarray, k: int, nprobe: Optional[int] = None,
-               block: int = 256, rerank: Optional[int] = None
+               block: int = 256, rerank: Optional[int] = None,
+               predicate=None, escalate: float = 4.0
                ) -> Tuple[np.ndarray, np.ndarray, Dict[str, int]]:
         """ANN top-k: (scores [Nq, k] f32, page_ids [Nq, k] i64 -1-padded,
-        stats). Centroid scoring runs on device through `chunked_topk`
-        (queries padded to a power-of-two bucket, one compiled program per
-        octave); queries are then processed in `block`-sized sub-blocks —
-        per sub-block ONE gathered candidate matmul via
-        `rerank_candidates`, dispatched async so sub-block i+1's host
-        gather overlaps sub-block i's device re-rank.
+        stats) — see _search_once for the scoring machinery. `predicate`
+        (index/attrs.py Predicate) restricts results to matching rows,
+        intersected with the posting gathers BEFORE any candidate bytes
+        move. A filtered probe set can under-fill k (the matching rows
+        may live in un-probed lists): `escalate` > 1 re-searches the
+        under-filled queries with nprobe multiplied per round until they
+        fill or the probe set reaches nlist — the drain-more-lists
+        escalation, counted in stats["filter_escalations"]."""
+        out_s, out_i, stats = self._search_once(
+            qvecs, k, nprobe=nprobe, block=block, rerank=rerank,
+            predicate=predicate)
+        if predicate is None or not escalate or escalate <= 1:
+            return out_s, out_i, stats
+        np_eff = int(min(max(1, nprobe or 1), self.nlist))
+        k = int(min(k, max(out_i.shape[1], 1)))
+        while np_eff < self.nlist:
+            need = (out_i >= 0).sum(axis=1) < k
+            if not need.any():
+                break
+            np_eff = int(min(self.nlist,
+                             max(np_eff + 1, math.ceil(np_eff * escalate))))
+            s2, i2, st2 = self._search_once(
+                np.asarray(qvecs, np.float32)[need], k, nprobe=np_eff,
+                block=block, rerank=rerank, predicate=predicate)
+            out_s[need], out_i[need] = s2, i2
+            n_esc = int(need.sum())
+            stats["filter_escalations"] = (
+                stats.get("filter_escalations", 0) + n_esc)
+            self.stats["filter_escalations"] = (
+                self.stats.get("filter_escalations", 0) + n_esc)
+            telemetry.default_registry().counter(
+                "ivf.filter_escalations").inc(n_esc)
+            for key in ("lists_scanned", "candidates_reranked",
+                        "gather_bytes", "reranked_rows", "hot_rows_scored"):
+                if key in st2:
+                    stats[key] = stats.get(key, 0) + st2[key]
+        return out_s, out_i, stats
+
+    def _search_once(self, qvecs: np.ndarray, k: int,
+                     nprobe: Optional[int] = None, block: int = 256,
+                     rerank: Optional[int] = None, predicate=None
+                     ) -> Tuple[np.ndarray, np.ndarray, Dict[str, int]]:
+        """One ANN pass: (scores [Nq, k] f32, page_ids [Nq, k] i64
+        -1-padded, stats). Centroid scoring runs on device through
+        `chunked_topk` (queries padded to a power-of-two bucket, one
+        compiled program per octave); queries are then processed in
+        `block`-sized sub-blocks — per sub-block ONE gathered candidate
+        matmul via `rerank_candidates`, dispatched async so sub-block
+        i+1's host gather overlaps sub-block i's device re-rank.
 
         On a PQ index (manifest "pq" section) the sub-blocks route
         through the ADC path instead (_search_adc): candidates score from
         m-byte codes, and only each query's top-`rerank` ADC survivors
         (default max(8k, 64)) are gathered at stored width for the exact
         final top-k. stats["gather_bytes"] reports the store payload
-        bytes either path actually moved."""
+        bytes either path actually moved — with a `predicate`, the
+        posting rows it rejects are dropped before the gather, so this
+        number falls in proportion to selectivity."""
         qvecs = np.asarray(qvecs, np.float32)
         nq = qvecs.shape[0]
         k = int(k)
@@ -861,13 +944,15 @@ class IVFIndex:
         reg.counter("ivf.lists_scanned").inc(nq * nprobe)
         if self.pq is not None:
             return self._search_adc(qvecs, sel, k, block, rerank,
-                                    out_s, out_i, stats)
+                                    out_s, out_i, stats,
+                                    predicate=predicate)
         pending = []
         for s in range(0, nq, block):
             e = min(s + block, nq)
             sel_b = sel[s:e]
             cents = np.unique(sel_b)
-            cand, scl, cids, ccent = self._gather(cents)
+            cand, scl, cids, ccent = self._gather(cents,
+                                                  predicate=predicate)
             C = cand.shape[0]
             stats["gather_bytes"] += C * self.store.row_bytes
             if C == 0:
@@ -912,7 +997,8 @@ class IVFIndex:
 
     def _search_adc(self, qvecs: np.ndarray, sel: np.ndarray, k: int,
                     block: int, rerank: Optional[int],
-                    out_s: np.ndarray, out_i: np.ndarray, stats: Dict
+                    out_s: np.ndarray, out_i: np.ndarray, stats: Dict,
+                    predicate=None
                     ) -> Tuple[np.ndarray, np.ndarray, Dict[str, int]]:
         """The compressed-payload block loop (docs/ANN.md): per sub-block,
         gather the probed lists' m-byte CODES (mmap — resident lists skip
@@ -934,7 +1020,8 @@ class IVFIndex:
             cents = np.unique(sel_b)
             cold_cents = (cents[~hot["lists"][cents]] if hot is not None
                           else cents)
-            codes, cids, ccent, csh, crw = self._gather_codes(cold_cents)
+            codes, cids, ccent, csh, crw = self._gather_codes(
+                cold_cents, predicate=predicate)
             C = codes.shape[0]
             stats["gather_bytes"] += C * m
             # pow-2 query bucket (same rule as the uncompressed path)
@@ -972,7 +1059,16 @@ class IVFIndex:
                               np.where(ok, csh[idx], -1),
                               np.where(ok, crw[idx], -1)))
             if hot is not None and hot["rows"]:
-                hs, hpos = adc_topr(lut, hot["codes"], hot["cent"],
+                # filtered queries mask resident rows ON DEVICE: attribute
+                # words staged next to the codes, one and+compare per
+                # predicate alternative, non-matching rows -> centroid -2
+                # (matches no probed list) before the ADC scan
+                hcent = hot["cent"]
+                if predicate is not None:
+                    hcent = jnp.where(
+                        predicate.matches_device(hot["attrs"]),
+                        hcent, jnp.int32(-2))
+                hs, hpos = adc_topr(lut, hot["codes"], hcent,
                                     sel_dev, r=r, chunk=hot["chunk"])
                 hs, hpos = np.asarray(hs), np.asarray(hpos)
                 ok = (hpos >= 0) & (hpos < hot["rows"])

@@ -369,12 +369,15 @@ class PartitionSet:
 
     # -- the scatter-gather ------------------------------------------------
     def topk(self, qv: np.ndarray, n: int, k: int,
-             nprobe: Optional[int] = None
+             nprobe: Optional[int] = None, predicate=None
              ) -> Tuple[np.ndarray, np.ndarray]:
         """Scatter the (already encoded) query matrix to one routed
         replica per partition, gather each partition's local top-k, fold
         through the partition merge tree. Returns (scores [n, k] fp32,
-        page_ids [n, k] int64)."""
+        page_ids [n, k] int64). `predicate` (index/attrs.py) rides the
+        scatter verbatim: each partition intersects it with its own scan
+        and the merge fold is predicate-blind — filtered results stay
+        byte-identical to the single-view filtered path."""
         svc = self._svc
         qv = np.asarray(qv, np.float32)
         # ONE table snapshot for the whole scatter: every partition
@@ -391,13 +394,14 @@ class PartitionSet:
                 rep = self._route(pid)
                 view = table[pid][rep.rid]
                 futs.append(rep.submit(
-                    lambda v=view: svc._topk_view(v, qv, n, k, nprobe)))
+                    lambda v=view: svc._topk_view(v, qv, n, k, nprobe,
+                                                  predicate=predicate)))
             parts = [f.result() for f in futs]
         with svc._stage("merge"):
             return merge_partition_topk([(s, i) for s, i, _ in parts])
 
     def simulate(self, qv: np.ndarray, n: int, k: int,
-                 nprobe: Optional[int] = None) -> Dict:
+                 nprobe: Optional[int] = None, predicate=None) -> Dict:
         """Host-simulation mode (bench `partitioned_serve` phase): run
         every partition's task SEQUENTIALLY on the caller, timing each,
         then the merge fold. The simulated per-query latency is the
@@ -413,7 +417,8 @@ class PartitionSet:
             rep = self._route(pid)
             view = table[pid][rep.rid]
             (res, dt) = rep.run_inline(
-                lambda v=view: svc._topk_view(v, qv, n, k, nprobe))
+                lambda v=view: svc._topk_view(v, qv, n, k, nprobe,
+                                              predicate=predicate))
             parts.append(res)
             times.append(dt)
             scans.append(int(res[2]))

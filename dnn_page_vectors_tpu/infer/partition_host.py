@@ -77,10 +77,10 @@ import numpy as np
 from dnn_page_vectors_tpu.infer import transport
 from dnn_page_vectors_tpu.utils import faults
 from dnn_page_vectors_tpu.infer.transport import (
-    DeadlineExceeded, FrameError, FLAG_RESULT_CACHE, FLAG_WIRE_COMPRESS,
-    FrameSender, InternTable, RemoteError, T_BYE, T_DRAIN, T_HEARTBEAT,
-    T_HELLO, T_REFRESH, T_REGISTER, T_RESULT, T_RESULT_C, T_SHED, T_ERROR,
-    T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF)
+    DeadlineExceeded, FrameError, FLAG_FILTERS, FLAG_RESULT_CACHE,
+    FLAG_WIRE_COMPRESS, FrameSender, InternTable, RemoteError, T_BYE,
+    T_DRAIN, T_HEARTBEAT, T_HELLO, T_REFRESH, T_REGISTER, T_RESULT,
+    T_RESULT_C, T_SHED, T_ERROR, T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF)
 from dnn_page_vectors_tpu.ops.topk import merge_partition_topk
 from dnn_page_vectors_tpu.utils.profiling import LatencyStats
 
@@ -221,6 +221,12 @@ class WorkerGateway:
             serve_cfg is not None
             and getattr(serve_cfg, "result_cache", False)
             and getattr(serve_cfg, "result_cache_fleet", False))
+        # filtered retrieval (docs/ANN.md "Filtered retrieval"): what
+        # THIS end confirms when a worker advertises FLAG_FILTERS — a
+        # filtered scatter only routes a partition to a worker that
+        # negotiated the flag; everyone else's slice serves locally
+        self._filters = bool(getattr(serve_cfg, "filters", True)
+                             if serve_cfg is not None else True)
         # per-replica circuit breakers (docs/ROBUSTNESS.md "Network
         # failure model"): serve.breaker_failures consecutive wire
         # failures open a replica's breaker and routing skips it until a
@@ -339,7 +345,8 @@ class WorkerGateway:
             pid_, rid, wpid, wflags, wgen = transport.decode_register(
                 frame[1])
             agreed = wflags & ((FLAG_WIRE_COMPRESS if self._compress else 0)
-                               | (FLAG_RESULT_CACHE if self._rcache else 0))
+                               | (FLAG_RESULT_CACHE if self._rcache else 0)
+                               | (FLAG_FILTERS if self._filters else 0))
             worker = _WorkerConn(conn, addr, pid_, rid, wpid,
                                  flags=agreed, generation=wgen)
             with self._lock:
@@ -369,6 +376,7 @@ class WorkerGateway:
                 "addr": f"{addr[0]}:{addr[1]}",
                 "wire_compress": bool(agreed & FLAG_WIRE_COMPRESS),
                 "result_cache": bool(agreed & FLAG_RESULT_CACHE),
+                "filters": bool(agreed & FLAG_FILTERS),
                 "generation": wgen})
             if rejoined:
                 # liveness restored: the fresh connection wipes the
@@ -627,7 +635,8 @@ class WorkerGateway:
     def _pick_worker(self, pid: int, prefer_rid: int,
                      exclude: Tuple[int, ...] = (),
                      generation: Optional[int] = None,
-                     split: Optional[int] = None
+                     split: Optional[int] = None,
+                     require_flags: int = 0
                      ) -> Optional[_WorkerConn]:
         """The live worker that should answer partition `pid`: the routed
         replica's own worker when live, else the lowest-rid live sibling
@@ -643,7 +652,11 @@ class WorkerGateway:
         skipped unconditionally (its slice falls back to the local
         view). A replica whose circuit breaker is open is skipped the
         same way — the breaker check runs LAST because a half-open
-        breaker's allow() consumes its single probe slot."""
+        breaker's allow() consumes its single probe slot. `require_flags`
+        restricts to workers whose NEGOTIATED capability set covers the
+        mask — a filtered scatter passes FLAG_FILTERS here, so a legacy
+        worker is simply unroutable for that request (its slice serves
+        from the local filtered view: never wrong results)."""
         with self._lock:
             cands = [(rid, w) for (p, rid), w in self._workers.items()
                      if p == pid and rid not in exclude]
@@ -651,6 +664,7 @@ class WorkerGateway:
         age = self._alive_age_s()
         for _, w in cands:
             if w.alive(age) and not w.draining \
+                    and (w.flags & require_flags) == require_flags \
                     and (generation is None
                          or w.generation == generation) \
                     and (split is None or w.split == split) \
@@ -669,7 +683,8 @@ class WorkerGateway:
 
     def _send(self, worker: _WorkerConn, prep: Tuple[bytes, int, int],
               k: int, nprobe: Optional[int],
-              deadline: Optional[float]) -> Future:
+              deadline: Optional[float],
+              ftext: Optional[str] = None) -> Future:
         svc = self._svc
         block, n, dim = prep
         req_id = transport.next_request_id()
@@ -678,6 +693,10 @@ class WorkerGateway:
             rem_ms = max((deadline - svc._clock()) * 1000.0, 0.001)
         head = transport._VQUERY_HEAD.pack(req_id, rem_ms, int(k),
                                            int(nprobe or 0), n, dim)
+        # the optional predicate field is PER REQUEST — it rides after
+        # the block on every variant and is never interned (routing
+        # guarantees this worker negotiated FLAG_FILTERS when non-empty)
+        tail = transport._filters_field(ftext)
         fut: Future = Future()
         with self._lock:
             self._pending[req_id] = (fut, worker)
@@ -689,20 +708,22 @@ class WorkerGateway:
                     # slot; repeats cost a 2-byte reference
                     slot, fresh = worker.intern.slot_for(block)
                     slot_b = transport._SLOT.pack(slot)
-                    raw = (transport.HEADER.size + len(head) + len(block))
+                    raw = (transport.HEADER.size + len(head) + len(block)
+                           + len(tail))
                     if fresh:
                         worker.sender.send(T_VQUERY_PUT, head, slot_b,
-                                           block,
+                                           block, tail,
                                            counter=svc._m_wire_bytes,
                                            raw_counter=svc._m_wire_raw,
                                            raw_len=raw)
                     else:
                         worker.sender.send(T_VQUERY_REF, head, slot_b,
+                                           tail,
                                            counter=svc._m_wire_bytes,
                                            raw_counter=svc._m_wire_raw,
                                            raw_len=raw)
                 else:
-                    worker.sender.send(T_VQUERY, head, block,
+                    worker.sender.send(T_VQUERY, head, block, tail,
                                        counter=svc._m_wire_bytes,
                                        raw_counter=svc._m_wire_raw)
         except OSError as e:
@@ -748,7 +769,8 @@ class WorkerGateway:
                          k: int, nprobe: Optional[int],
                          deadline: Optional[float],
                          generation: Optional[int] = None,
-                         split: Optional[int] = None
+                         split: Optional[int] = None,
+                         ftext: Optional[str] = None
                          ) -> Optional[Tuple]:
         """Wait for partition `pid`'s RPC answer, hedging to a sibling at
         the latency-quantile point and failing over on worker loss; None
@@ -802,11 +824,13 @@ class WorkerGateway:
                 # sibling (not a hedge — the first copy is already dead)
                 w = self._pick_worker(pid, prefer_rid,
                                       exclude=tuple(tried),
-                                      generation=generation, split=split)
+                                      generation=generation, split=split,
+                                      require_flags=(FLAG_FILTERS
+                                                     if ftext else 0))
                 if w is None:
                     return None
-                in_flight[self._send(w, prep, k, nprobe, deadline)] = \
-                    w.replica
+                in_flight[self._send(w, prep, k, nprobe, deadline,
+                                     ftext)] = w.replica
                 tried.add(w.replica)
                 continue
             if elapsed >= budget:
@@ -816,7 +840,9 @@ class WorkerGateway:
                 hedged = True
                 w = self._pick_worker(pid, prefer_rid,
                                       exclude=tuple(tried),
-                                      generation=generation, split=split)
+                                      generation=generation, split=split,
+                                      require_flags=(FLAG_FILTERS
+                                                     if ftext else 0))
                 if w is not None:
                     svc._m_hedge_fired.inc()
                     cur = svc.tracer.current()
@@ -825,22 +851,30 @@ class WorkerGateway:
                         "to_replica": w.replica,
                         "after_ms": round(elapsed * 1000.0, 3),
                     }, trace_id=getattr(cur, "trace_id", None))
-                    in_flight[self._send(w, prep, k, nprobe,
-                                         deadline)] = w.replica
+                    in_flight[self._send(w, prep, k, nprobe, deadline,
+                                         ftext)] = w.replica
                     tried.add(w.replica)
 
     # graftcheck: hot
     def topk(self, qv: np.ndarray, n: int, k: int,
              nprobe: Optional[int] = None,
-             deadline: Optional[float] = None
-             ) -> Tuple[np.ndarray, np.ndarray]:
+             deadline: Optional[float] = None,
+             predicate=None) -> Tuple[np.ndarray, np.ndarray]:
         """The over-the-wire scatter-gather: one routed worker RPC per
         partition (hedged, deadline-budgeted), per-partition LOCAL
         fallback on any wire failure, winners folded through the same
         partition merge tree as the in-process scatter — results
-        byte-identical to `PartitionSet.topk` by construction."""
+        byte-identical to `PartitionSet.topk` by construction.
+
+        With `predicate` (a compiled `index/attrs.Predicate`) the
+        canonical text rides each RPC's optional filter field, routing
+        restricts to FLAG_FILTERS workers, and every fallback slice runs
+        the same filtered `_topk_view` — so the filtered result set is
+        byte-identical to the in-process filtered scatter too."""
         svc = self._svc
         pset = self.partition_set
+        ftext = predicate.text if predicate is not None else None
+        req_flags = FLAG_FILTERS if ftext else 0
         # ONE table snapshot anchors the whole scatter: its length IS
         # the split width every per-partition decision below is gated
         # on, so a concurrent elastic re-split (which publishes a new
@@ -858,12 +892,14 @@ class WorkerGateway:
                 rep = pset._route(pid)
                 gen = table[pid][rep.rid].generation
                 w = self._pick_worker(pid, rep.rid, generation=gen,
-                                      split=split)
+                                      split=split,
+                                      require_flags=req_flags)
                 if w is None:
                     calls.append((pid, rep, None, -1))
                 else:
                     calls.append((pid, rep,
-                                  self._send(w, prep, k, nprobe, deadline),
+                                  self._send(w, prep, k, nprobe, deadline,
+                                             ftext),
                                   w.replica))
             parts: List[Optional[Tuple]] = [None] * P
             for pid, rep, fut, rid in calls:
@@ -874,7 +910,7 @@ class WorkerGateway:
                             pid, rep.rid, fut, rid, prep, k, nprobe,
                             deadline,
                             generation=table[pid][rep.rid].generation,
-                            split=split)
+                            split=split, ftext=ftext)
                 if res is None:
                     # the in-process degrade path, verbatim: this
                     # partition's slice computed on the front end's own
@@ -884,7 +920,8 @@ class WorkerGateway:
                         with self._lock:
                             self._rpc_fallbacks += 1
                     view = table[pid][rep.rid]
-                    res = svc._topk_view(view, qv, n, k, nprobe)
+                    res = svc._topk_view(view, qv, n, k, nprobe,
+                                         predicate=predicate)
                 parts[pid] = res
         with svc._stage("merge"):
             return merge_partition_topk([(s, i) for s, i, _ in parts])
@@ -1051,11 +1088,14 @@ class WorkerGateway:
             compressing = sum(
                 1 for w in workers
                 if not w.dead and w.flags & FLAG_WIRE_COMPRESS)
+            filtering = sum(1 for w in workers
+                            if not w.dead and w.flags & FLAG_FILTERS)
             breakers = list(self._breakers.values())
         return {
             "workers_live": len(self.live_workers()),
             "workers_registered": registered,
             "workers_compressing": compressing,
+            "workers_filtering": filtering,
             "workers_draining": sum(1 for w in workers
                                     if not w.dead and w.draining),
             "rpcs": rpcs,
@@ -1175,6 +1215,9 @@ class PartitionWorker:
         self.result_cache = bool(
             getattr(cfg.serve, "result_cache", False)
             and getattr(cfg.serve, "result_cache_fleet", False))
+        # filtered retrieval, advertised like compression: the gateway
+        # only ships the VQUERY filter field after confirming the flag
+        self.filters = bool(getattr(cfg.serve, "filters", True))
         self._block_cache_cap = 64   # per-link block-cache entries
         # drill hook (tests, the bench hedge drill): added per-request
         # latency, so a deliberately slow replica provokes hedging
@@ -1307,7 +1350,8 @@ class PartitionWorker:
                         flags=(FLAG_WIRE_COMPRESS
                                if self.wire_compress else 0)
                         | (FLAG_RESULT_CACHE
-                           if self.result_cache else 0),
+                           if self.result_cache else 0)
+                        | (FLAG_FILTERS if self.filters else 0),
                         generation=view.generation))
             except OSError:
                 try:
@@ -1436,6 +1480,12 @@ class PartitionWorker:
             if self.slow_ms > 0:
                 time.sleep(self.slow_ms / 1000.0)
             k = req.k or self.svc.cfg.eval.recall_k
+            # the filter field only arrives when the gateway negotiated
+            # FLAG_FILTERS with us; the canonical text folds into the
+            # block-cache key so a filtered answer never replays for an
+            # unfiltered repeat of the same block (or vice versa)
+            from dnn_page_vectors_tpu.infer.serve import _compile_filters
+            pred = _compile_filters(req.filters)
             # ONE view snapshot answers this request — the compute, the
             # cache hit check, and the cache fill all reference it, so a
             # concurrent refresh/re-split swap can't mix states
@@ -1448,7 +1498,8 @@ class PartitionWorker:
                 # (identity check below), which makes it byte-identical
                 # to a recompute — and unreachable the moment a refresh
                 # or re-split swaps the view
-                ckey = (req.qv.tobytes(), k, int(req.nprobe or 0))
+                ckey = (req.qv.tobytes(), k, int(req.nprobe or 0),
+                        req.filters or "")
                 hit = link.block_cache.get(ckey)
                 if hit is not None and hit[0] is view:
                     link.block_cache.move_to_end(ckey)
@@ -1459,7 +1510,7 @@ class PartitionWorker:
             else:
                 scores, ids, scan = self.svc._topk_view(
                     view, req.qv, req.qv.shape[0], k,
-                    req.nprobe or None)
+                    req.nprobe or None, predicate=pred)
                 if ckey is not None:
                     link.block_cache[ckey] = (view, scores, ids, scan)
                     while len(link.block_cache) > self._block_cache_cap:

@@ -173,19 +173,23 @@ class _MicroBatcher:
 
     def submit(self, query: str, k: Optional[int],
                nprobe: Optional[int] = None,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               filters: Optional[str] = None) -> Future:
         """Enqueue one request. `deadline` is ABSOLUTE on the service
         clock (svc._clock); admission-time shedding (expired / SLO
         budget) happens in the CALLER (`SearchService._admit`) before
         anything touches this queue — an already-hopeless request must
-        never consume queue capacity or a bucket slot."""
+        never consume queue capacity or a bucket slot. `filters` is the
+        CANONICAL predicate text (index/attrs.py) or None: coalescing
+        groups per distinct (k, nprobe, filters), so a filtered request
+        can never share a dispatch with a differently-filtered one."""
         fut: Future = Future()
         # capture the caller's active span HERE: the dispatcher runs on
         # another thread where the contextvar chain breaks, so the trace
         # context rides the queue explicitly (docs/OBSERVABILITY.md)
         ctx = self._svc.tracer.current()
-        self._q.put((query, (k, nprobe), fut, time.perf_counter(), ctx,
-                     deadline))
+        self._q.put((query, (k, nprobe, filters), fut, time.perf_counter(),
+                     ctx, deadline))
         return fut
 
     def _run(self) -> None:
@@ -246,7 +250,7 @@ class _MicroBatcher:
         by_key: Dict[tuple, list] = {}
         for query, key, fut, _, ctx, deadline in batch:
             by_key.setdefault(key, []).append((query, fut, ctx, deadline))
-        for (k, nprobe), items in by_key.items():
+        for (k, nprobe, ftext), items in by_key.items():
             # the shared dispatch honors the TIGHTEST deadline of the
             # coalesced group: the RPC fan-out budgets per-partition
             # waits against it
@@ -261,7 +265,7 @@ class _MicroBatcher:
                                   batch_size=len(items)) as dsp:
                     res = svc.search_many(
                         [q for q, _, _, _ in items], k=k, nprobe=nprobe,
-                        _record=False, deadline=group_dl)
+                        filters=ftext, _record=False, deadline=group_dl)
             except BaseException:  # noqa: BLE001 — isolate per request
                 for q, fut, ctx, deadline in items:
                     try:
@@ -269,7 +273,7 @@ class _MicroBatcher:
                         # on THIS thread so retry spans nest under it
                         with tracer.use(ctx):
                             fut.set_result(svc.search_many(
-                                [q], k=k, nprobe=nprobe,
+                                [q], k=k, nprobe=nprobe, filters=ftext,
                                 _record=False, deadline=deadline)[0])
                     except BaseException as e:  # noqa: BLE001
                         fut.set_exception(e)
@@ -355,6 +359,17 @@ class AdaptiveWindow:
         if self._on_change is not None:
             self._on_change(cur, new, p99, reason)
         return new
+
+
+def _compile_filters(spec):
+    """Normalize a filters argument (None / predicate text / compiled
+    Predicate) to a Predicate-or-None. Lazy import: `index/__init__`
+    pulls the whole ANN stack, which serve only loads when routing
+    through it (same reason `_index()` imports ivf in-function)."""
+    if spec is None or spec == "":
+        return None
+    from dnn_page_vectors_tpu.index import attrs as attrs_mod
+    return attrs_mod.compile_filters(spec)
 
 
 def _merge_topk_host(s1, i1, s2, i2, k: int):
@@ -558,6 +573,14 @@ class SearchService:
         # with zero per-request host gather
         self._pq_rerank = (getattr(serve_cfg, "pq_rerank", 0)
                            if serve_cfg is not None else 0)
+        # filtered retrieval (docs/ANN.md "Filtered retrieval"):
+        # serve.filters gates accepting/advertising predicates on the
+        # wire; serve.filter_escalate is the probe-widening factor when
+        # a filtered IVF probe set under-fills k (<=1 disables)
+        self._filters_enabled = (getattr(serve_cfg, "filters", True)
+                                 if serve_cfg is not None else True)
+        self._filter_escalate = (getattr(serve_cfg, "filter_escalate", 4.0)
+                                 if serve_cfg is not None else 4.0)
         self._hot_gb = (getattr(serve_cfg, "hot_postings_gb", 0.0)
                         if serve_cfg is not None else 0.0)
         # partitioned + replicated serving (infer/partition.py,
@@ -1230,7 +1253,7 @@ class SearchService:
                         "serving the exact path until a rebuild")
 
     def _ann_topk(self, view: "_ServeView", qv: np.ndarray, n: int, k: int,
-                  nprobe: Optional[int] = None):
+                  nprobe: Optional[int] = None, predicate=None):
         """ANN (scores [n, k], page_ids [n, k], scan_bytes) for `n` real
         queries, or None to fall back to the exact path (index missing,
         stale against the view store's CURRENT model step, mid-migration
@@ -1255,14 +1278,18 @@ class SearchService:
             with self._stage("topk") as sp:
                 scores, ids, st = idx.search(
                     qv[:n], k=k, nprobe=nprobe,
-                    rerank=self._pq_rerank or None)
+                    rerank=self._pq_rerank or None,
+                    predicate=predicate,
+                    escalate=self._filter_escalate)
                 # the ANN cost triple ON the request's span (why THIS
                 # query was slow): lists probed, payload bytes gathered,
-                # rows exact-reranked
+                # rows exact-reranked — plus, filtered, how many queries
+                # under-filled k and re-probed wider
                 sp.set_attrs(
                     lists_scanned=st.get("lists_scanned", 0),
                     gather_bytes=st.get("gather_bytes", 0),
-                    rows_reranked=st.get("candidates_reranked", 0))
+                    rows_reranked=st.get("candidates_reranked", 0),
+                    filter_escalations=st.get("filter_escalations", 0))
         except Exception as e:  # noqa: BLE001 — any index failure degrades
             view.index = None
             view.index_error = f"{type(e).__name__}: {e}"
@@ -1474,11 +1501,17 @@ class SearchService:
     # -- generation-keyed result cache (docs/SERVING.md "Result cache") ---
     def _result_cache_key(self, query: str, k: Optional[int],
                           nprobe: Optional[int],
-                          view=None) -> Optional[tuple]:
-        """(normalized text, k, nprobe, store gen, index gen) — or None
-        when the cache is off. Generations in the KEY are the whole
-        invalidation story: refresh() bumps them, so an entry filled
-        against the old view can never answer a post-swap probe.
+                          view=None, filters=None) -> Optional[tuple]:
+        """(normalized text, k, nprobe, store gen, index gen, predicate)
+        — or None when the cache is off. Generations in the KEY are the
+        whole invalidation story: refresh() bumps them, so an entry
+        filled against the old view can never answer a post-swap probe.
+
+        The predicate slot is the CANONICAL filter text ("" unfiltered,
+        index/attrs.py): a filtered hit and its unfiltered twin live
+        under different keys, so a filtered probe can never be answered
+        by an unfiltered fill (or vice versa) — same staleness-zero
+        story as the generations, by construction not by TTL.
 
         The store-gen slot COMPOSES the view's model stamp into its high
         32 bits (docs/MAINTENANCE.md "Rolling model migration"): scores
@@ -1499,7 +1532,8 @@ class SearchService:
         sgen = ((int(view.generation) & 0xFFFFFFFF)
                 | ((int(view.store.model_step or 0) & 0xFFFFFFFF) << 32))
         return (self._normalize(query), int(k or self.cfg.eval.recall_k),
-                int(nprobe or 0), sgen, int(index_gen))
+                int(nprobe or 0), sgen, int(index_gen),
+                str(getattr(filters, "text", filters) or ""))
 
     def _result_cache_get(self, key: Optional[tuple],
                           count: bool = True) -> Optional[list]:
@@ -1579,7 +1613,13 @@ class SearchService:
         peers = self._peers_with_breakers()
         if not peers:
             return None
-        text, k, nprobe, store_gen, index_gen = key
+        text, k, nprobe, store_gen, index_gen, ftext = key
+        if ftext:
+            # the peer-cache wire format (`transport._CACHE_HEAD`) has no
+            # predicate slot: filtered entries stay front-end-local, so a
+            # cross-peer probe can never alias a filtered key onto an
+            # unfiltered sibling entry
+            return None
         for peer, br in peers:
             if br is not None and not br.allow():
                 continue         # breaker open: skip the down sibling
@@ -1607,7 +1647,9 @@ class SearchService:
         peers = self._peers_with_breakers()
         if not peers:
             return
-        text, k, nprobe, store_gen, index_gen = key
+        text, k, nprobe, store_gen, index_gen, ftext = key
+        if ftext:
+            return               # filtered fills never ship to peers
         scores = np.full((k,), -np.inf, np.float32)
         ids = np.full((k,), -1, np.int64)
         for i, h in enumerate(hits[:k]):
@@ -1643,7 +1685,7 @@ class SearchService:
         if self._rcache_cap <= 0 or not self._rcache_fleet:
             return None
         key = (self._normalize(ck.query), ck.k, int(ck.nprobe),
-               ck.store_gen, ck.index_gen)
+               ck.store_gen, ck.index_gen, "")
         hits = self._result_cache_get(key)
         if hits is None:
             return None
@@ -1669,7 +1711,7 @@ class SearchService:
         if (live[3], live[4]) != (ck.store_gen, ck.index_gen):
             return False         # stale generations: drop
         key = (self._normalize(ck.query), ck.k, int(ck.nprobe),
-               ck.store_gen, ck.index_gen)
+               ck.store_gen, ck.index_gen, "")
         self._result_cache_put(
             key, self._format(np.asarray(scores).reshape(-1),
                               np.asarray(ids).reshape(-1)))
@@ -2033,7 +2075,8 @@ class SearchService:
     def search(self, query: str, k: Optional[int] = None,
                nprobe: Optional[int] = None,
                deadline_ms: Optional[float] = None,
-               deadline: Optional[float] = None) -> List[Dict]:
+               deadline: Optional[float] = None,
+               filters=None) -> List[Dict]:
         """One query -> top-k results. With the micro-batcher running
         (start_batcher), the call enqueues and blocks on its future —
         concurrent callers share dispatches; otherwise it is a direct
@@ -2054,7 +2097,16 @@ class SearchService:
         admission — or at the micro-batch door if it expires while
         queued — with DeadlineExceeded; sheds count in
         serve.deadline_shed, never in serve.errors (docs/SERVING.md
-        "Network front end")."""
+        "Network front end").
+
+        `filters` restricts results to rows whose packed attribute word
+        satisfies the predicate (text or compiled, index/attrs.py,
+        docs/ANN.md "Filtered retrieval"): the canonical form keys the
+        cache and the batcher's coalescing group, the IVF path
+        intersects it with the posting gather BEFORE ADC scoring, and
+        the exact fallback scans only matching rows. A malformed
+        predicate raises FilterError (a ValueError) before admission."""
+        pred = _compile_filters(filters)
         if deadline is None:
             deadline = self.default_deadline(deadline_ms)
         # result-cache probe at the admission door (docs/SERVING.md
@@ -2062,7 +2114,7 @@ class SearchService:
         # can never be shed and never consumes a micro-batch bucket
         # slot — the generation-qualified key makes a stale hit
         # impossible, not merely unlikely
-        rkey = self._result_cache_key(query, k, nprobe)
+        rkey = self._result_cache_key(query, k, nprobe, filters=pred)
         if rkey is not None:
             t0 = time.perf_counter()
             hits = self._result_cache_get(rkey, count=False)
@@ -2081,14 +2133,16 @@ class SearchService:
         b = self._batcher
         if b is None:
             return self.search_many([query], k=k, nprobe=nprobe,
-                                    deadline=deadline,
+                                    filters=pred, deadline=deadline,
                                     _probe_cache=False)[0]
         t0 = time.perf_counter()
         try:
             with self.tracer.trace("search",
                                    k=k or self.cfg.eval.recall_k,
                                    query=self._normalize(query)[:80]):
-                res = b.submit(query, k, nprobe, deadline=deadline).result()
+                res = b.submit(query, k, nprobe, deadline=deadline,
+                               filters=pred.text if pred is not None
+                               else None).result()
         except DeadlineExceeded:
             # the micro-batch door shed it (expired while queued): a
             # deliberate availability decision, already counted in
@@ -2102,7 +2156,7 @@ class SearchService:
         return res
 
     def search_many(self, queries: Sequence[str], k: Optional[int] = None,
-                    nprobe: Optional[int] = None,
+                    nprobe: Optional[int] = None, filters=None,
                     *, _record: bool = True, _probe_cache: bool = True,
                     deadline: Optional[float] = None) -> List[List[Dict]]:
         """Vectorized multi-query search: one result list per query, in
@@ -2116,11 +2170,15 @@ class SearchService:
         direct callers, a child span inside a batcher dispatch) and — for
         direct callers (`_record`) — counts every query into the windowed
         request/error/latency instruments; the batcher records per-request
-        numbers itself so coalesced queries are never double-counted."""
+        numbers itself so coalesced queries are never double-counted.
+        `filters` applies ONE attribute predicate (text or compiled,
+        index/attrs.py) to the whole batch — per-query predicates arrive
+        as separate calls (the batcher coalesces per predicate)."""
         k = k or self.cfg.eval.recall_k
         n = len(queries)
         if n == 0:
             return []
+        pred = _compile_filters(filters)
         # result-cache shortcut for direct callers (`_record` — batcher
         # dispatches and search()'s delegated misses skip the re-probe):
         # an ALL-hit batch answers without embedding or scanning anything;
@@ -2129,8 +2187,8 @@ class SearchService:
         if _record and _probe_cache and self._rcache_cap > 0:
             t0 = time.perf_counter()
             cached = [self._result_cache_get(
-                self._result_cache_key(q, k, nprobe), count=False)
-                for q in queries]
+                self._result_cache_key(q, k, nprobe, filters=pred),
+                count=False) for q in queries]
             miss_n = sum(1 for c in cached if c is None)
             if miss_n == 0:
                 self._m_rcache_hits.inc(n)
@@ -2148,7 +2206,7 @@ class SearchService:
         try:
             with self.tracer.root_or_span("search_many", n_queries=n, k=k):
                 out = self._search_view(view, list(queries), n, k, nprobe,
-                                        deadline=deadline)
+                                        deadline=deadline, predicate=pred)
         except BaseException:
             if _record:
                 self._m_errors.inc(n)
@@ -2162,7 +2220,13 @@ class SearchService:
     def _search_view(self, view: "_ServeView", queries: List[str],
                      n: int, k: int,
                      nprobe: Optional[int] = None,
-                     deadline: Optional[float] = None) -> List[List[Dict]]:
+                     deadline: Optional[float] = None,
+                     predicate=None) -> List[List[Dict]]:
+        if predicate is not None:
+            # one event per filtered dispatch (docs/OBSERVABILITY.md):
+            # which predicate ran, how many queries rode it
+            self.registry.event("filtered_query", {
+                "predicate": predicate.text[:200], "n_queries": n})
         # mid-migration the view serves two stamps: encode the batch once
         # per stamp (stacked [n, S*D]) so every shard can be scored by the
         # tower matching its recorded model step; the stacked matrix ships
@@ -2185,15 +2249,18 @@ class SearchService:
             # fallback that keeps results byte-identical when a worker
             # dies mid-request
             best_s, best_i = fanout.topk(qv, n, k, nprobe,
-                                         deadline=deadline)
+                                         deadline=deadline,
+                                         predicate=predicate)
         elif self._pset is not None:
             # partitioned scatter-gather (infer/partition.py): the
             # coalesced bucket's query matrix broadcasts ONCE to every
             # partition; each answers its local top-k over only its shard
             # range, results fold through the partition merge tree
-            best_s, best_i = self._pset.topk(qv, n, k, nprobe)
+            best_s, best_i = self._pset.topk(qv, n, k, nprobe,
+                                             predicate=predicate)
         else:
-            best_s, best_i, _ = self._topk_view(view, qv, n, k, nprobe)
+            best_s, best_i, _ = self._topk_view(view, qv, n, k, nprobe,
+                                                predicate=predicate)
         with self._stage("format"):
             out = [self._format(best_s[i], best_i[i]) for i in range(n)]
         if self._rcache_cap > 0:
@@ -2202,15 +2269,16 @@ class SearchService:
             # the old (now unreachable) key, so a stale fill can never
             # answer a post-swap probe — staleness-zero by construction
             for q, hits in zip(queries, out):
-                key = self._result_cache_key(q, k, nprobe, view=view)
+                key = self._result_cache_key(q, k, nprobe, view=view,
+                                             filters=predicate)
                 self._result_cache_put(key, hits)
                 self._peer_put(key, hits)
         return out
 
     def topk_vectors(self, qv: np.ndarray, k: Optional[int] = None,
                      nprobe: Optional[int] = None,
-                     deadline: Optional[float] = None
-                     ) -> tuple:
+                     deadline: Optional[float] = None,
+                     filters=None) -> tuple:
         """Raw retrieval for PRE-COMPUTED query vectors: (scores [n, k]
         fp32, page_ids [n, k] int64, -1-padded), skipping tokenize/encode
         and snippet formatting. The bench's host-simulated partitioned
@@ -2218,18 +2286,21 @@ class SearchService:
         tests drive the full serving top-k (RPC fan-out, partitioned, or
         single-view) through this without a model."""
         k = k or self.cfg.eval.recall_k
+        pred = _compile_filters(filters)
         qv = np.asarray(qv, np.float32)
         n = qv.shape[0]
         fanout = self._fanout
         if fanout is not None and fanout.active():
-            return fanout.topk(qv, n, k, nprobe, deadline=deadline)
+            return fanout.topk(qv, n, k, nprobe, deadline=deadline,
+                               predicate=pred)
         if self._pset is not None:
-            return self._pset.topk(qv, n, k, nprobe)
-        s, i, _ = self._topk_view(self._view, qv, n, k, nprobe)
+            return self._pset.topk(qv, n, k, nprobe, predicate=pred)
+        s, i, _ = self._topk_view(self._view, qv, n, k, nprobe,
+                                  predicate=pred)
         return s, i
 
     def _topk_view(self, view: "_ServeView", qv: np.ndarray, n: int, k: int,
-                   nprobe: Optional[int] = None):
+                   nprobe: Optional[int] = None, predicate=None):
         """Raw top-k of `n` real query rows of `qv` over ONE view:
         (scores [n, k] fp32, page_ids [n, k] int64, scan_bytes). This is
         the per-partition unit of work of the scatter-gather — a
@@ -2246,13 +2317,19 @@ class SearchService:
             # migration guard): each shard must be scored by its own
             # tower's block, which the exact path below routes per shard
             res = (self._ann_topk(view, next(iter(blocks.values())),
-                                  n, k, nprobe)
+                                  n, k, nprobe, predicate=predicate)
                    if len(view.steps) <= 1 else None)
             if res is not None:
                 return res
             # exact path serves this request; visible in metrics + counters
             self._m_ann_fallbacks.inc(n)
             faults.count("serve_ann_fallbacks", n)
+        if predicate is not None:
+            # filtered exact: host-mask each shard's attribute words and
+            # scan only the matching rows — the resident HBM program and
+            # the streaming sweep both score EVERY row, so neither can
+            # honor the scan-bytes contract for a predicate
+            return self._filtered_exact(view, blocks, n, k, predicate)
         B = self.query_batch
         row_bytes = view.store.row_bytes
         if view.shards is None:
@@ -2316,6 +2393,58 @@ class SearchService:
             out_i[s0: s0 + nreal] = bi[:nreal]
         scan = (sum(nv for _, nv, _, _ in view.shards)
                 + sum(e["count"] for e in view.stream_entries)) * row_bytes
+        return out_s, out_i, scan
+
+    def _filtered_exact(self, view: "_ServeView", blocks: Dict, n: int,
+                        k: int, predicate) -> tuple:
+        """Exact filtered retrieval over ONE view: per shard, evaluate
+        the predicate against the packed attribute words on host, gather
+        ONLY the matching rows, and fold their exact scores into the
+        running top-k (docs/ANN.md "Filtered retrieval"). Every topology
+        — local, partitioned scatter, socket fan-out — answers a
+        filtered exact query through this method over its own frozen
+        entry subset, and the stable host merge makes the folded result
+        byte-identical to the single-process filtered oracle, the same
+        contract the unfiltered exact path pins.
+
+        `scan_bytes` counts the attribute words read (4 B/row over the
+        view) plus the matching rows' stored payload: a predicate of
+        selectivity s scans ~s× the unfiltered exact bytes — the number
+        bench.py's filtered phase records against its <=0.3x gate."""
+        row_bytes = view.store.row_bytes
+        fallback = next(iter(blocks.values()))
+        out_s = np.full((n, k), -np.inf, np.float32)
+        out_i = np.full((n, k), -1, np.int64)
+        scan = 0
+        with self._stage("topk", path="filtered_exact"):
+            for entry in view.entries:
+                if entry["count"] == 0:
+                    continue
+                words = view.store.load_attrs(entry)
+                scan += int(words.nbytes)
+                keep = predicate.matches(words)
+                if not keep.any():
+                    continue
+                ids, vecs = view.store._load_entry(entry)
+                ids = ids[keep]
+                live = ids >= 0      # tombstones match nothing
+                if not live.any():
+                    continue
+                rows = np.asarray(np.asarray(vecs)[keep][live], np.float32)
+                ids = ids[live]
+                scan += int(rows.shape[0]) * row_bytes
+                qp = np.asarray(
+                    blocks.get(view.store.entry_step(entry), fallback)[:n],
+                    np.float32)
+                scores = qp @ rows.T
+                kk = min(k, scores.shape[1])
+                part = np.argpartition(-scores, kk - 1,
+                                       axis=1)[:, :kk]
+                out_s, out_i = _merge_topk_host(
+                    out_s, out_i,
+                    np.take_along_axis(scores, part, axis=1)
+                    .astype(np.float32),
+                    ids[part].astype(np.int64), k)
         return out_s, out_i, scan
 
     def _qv_blocks(self, view: "_ServeView",

@@ -40,8 +40,10 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from dnn_page_vectors_tpu.infer import transport
+from dnn_page_vectors_tpu.infer.serve import _compile_filters
 from dnn_page_vectors_tpu.infer.transport import (
-    DeadlineExceeded, FrameError, FLAG_RESULT_CACHE, FLAG_WIRE_COMPRESS,
+    DeadlineExceeded, FrameError, FLAG_FILTERS, FLAG_RESULT_CACHE,
+    FLAG_WIRE_COMPRESS,
     T_CACHE_LOOKUP, T_CACHE_PUT, T_HELLO, T_QUERY, T_RESULT, T_RESULT_C,
     T_SHED, T_ERROR, T_VQUERY, T_VQUERY_PUT, T_VQUERY_REF)
 
@@ -95,6 +97,10 @@ class SearchServer:
         # a peer that negotiates the flag gets CACHE_LOOKUP / CACHE_PUT
         # answered from / into the service's generation-keyed cache
         self._rcache = bool(getattr(svc, "_rcache_fleet", False))
+        # filtered retrieval (docs/ANN.md "Filtered retrieval"):
+        # serve.filters gates ADVERTISING the capability; decoding stays
+        # unconditional (negotiation governs what a peer sends)
+        self._filters = bool(getattr(svc, "_filters_enabled", True))
         self._executor = ThreadPoolExecutor(
             max_workers=executor_workers,
             thread_name_prefix="serve-socket")
@@ -208,7 +214,8 @@ class SearchServer:
                 if ftype == T_HELLO:
                     want = transport.decode_hello(payload)
                     mask = ((FLAG_WIRE_COMPRESS if self._compress else 0)
-                            | (FLAG_RESULT_CACHE if self._rcache else 0))
+                            | (FLAG_RESULT_CACHE if self._rcache else 0)
+                            | (FLAG_FILTERS if self._filters else 0))
                     flags = want & mask
                     if flags & FLAG_WIRE_COMPRESS and slots is None:
                         slots = {}
@@ -367,12 +374,16 @@ class SearchServer:
         request into the windowed serving instruments exactly once."""
         svc = self.svc
         with svc.tracer.use(root):
+            # compile the frame's predicate ONCE (canonicalizes whatever
+            # text the client sent); a malformed predicate raises
+            # FilterError here -> one T_ERROR answer, nothing admitted
+            pred = _compile_filters(req.filters)
             # result-cache probe at the admission door (docs/SERVING.md
             # "Result cache"): a repeated text query answers before
             # _admit can shed it or a bucket slot is consumed
             if not vectors and n == 1:
                 rkey = svc._result_cache_key(req.queries[0], req.k or None,
-                                             nprobe)
+                                             nprobe, filters=pred)
                 if rkey is not None:
                     t0 = time.perf_counter()
                     hits = svc._result_cache_get(rkey, count=False)
@@ -393,18 +404,21 @@ class SearchServer:
             try:
                 if vectors:
                     out = svc.topk_vectors(req.qv, k=k, nprobe=nprobe,
-                                           deadline=deadline)
+                                           deadline=deadline, filters=pred)
                     scores, ids = out[0], out[1]
                     scan = int(out[2]) if len(out) > 2 else 0
                 elif svc._batcher is not None and n == 1:
                     res = [svc._batcher.submit(
                         req.queries[0], req.k or None, nprobe,
-                        deadline=deadline).result()]
+                        deadline=deadline,
+                        filters=pred.text if pred is not None
+                        else None).result()]
                     scores, ids = _results_to_arrays(res, k)
                     scan = 0
                 else:
                     res = svc.search_many(list(req.queries),
                                           k=req.k or None, nprobe=nprobe,
+                                          filters=pred,
                                           _record=False, deadline=deadline)
                     scores, ids = _results_to_arrays(res, k)
                     scan = 0

@@ -95,6 +95,16 @@ Deadlines travel as RELATIVE remaining milliseconds (not absolute
 timestamps): the two ends of a socket do not share a clock, and a
 relative budget re-anchors on the receiver's own monotonic clock at
 receipt — clock skew costs at most the in-flight network time.
+
+Filtered retrieval (negotiated via FLAG_FILTERS, docs/ANN.md "Filtered
+retrieval"): T_QUERY and every T_VQUERY variant accept one OPTIONAL
+trailing field — a u16 length + the CANONICAL predicate text
+(index/attrs.py) in utf-8. Absent field = unfiltered, and an unfiltered
+frame is byte-identical to the pre-filters protocol; decoders accept
+the field unconditionally (negotiation governs what a peer SENDS, like
+compression), so a filtered gateway never ships the field to a worker
+that did not advertise FLAG_FILTERS — it serves that slice locally
+instead, never wrong results.
 """
 from __future__ import annotations
 
@@ -141,6 +151,7 @@ _TYPES = {T_QUERY, T_VQUERY, T_RESULT, T_SHED, T_ERROR, T_REGISTER,
 # capability flags (REGISTER / HELLO negotiation)
 FLAG_WIRE_COMPRESS = 0x01     # peer speaks T_RESULT_C + T_VQUERY_PUT/REF
 FLAG_RESULT_CACHE = 0x02      # peer speaks T_CACHE_LOOKUP / T_CACHE_PUT
+FLAG_FILTERS = 0x04           # peer accepts the QUERY/VQUERY filter field
 
 # per-connection intern table size: a protocol constant, so the sender's
 # slot assignment (a ring over these slots) and the receiver's passive
@@ -208,6 +219,7 @@ class QueryRequest:
     k: int                        # 0 means the server default
     nprobe: int                   # 0 means the server default
     queries: Tuple[str, ...]
+    filters: Optional[str] = None  # canonical predicate text; None = all
 
 
 @dataclass(frozen=True)
@@ -217,10 +229,45 @@ class VectorRequest:
     k: int
     nprobe: int
     qv: np.ndarray                # [n, dim] float32
+    filters: Optional[str] = None  # canonical predicate text; None = all
+
+
+def _filters_field(filters: Optional[str]) -> bytes:
+    """The optional trailing predicate field: u16 length + canonical
+    text. None encodes as NO bytes at all — an unfiltered frame is
+    byte-identical to the pre-filters protocol."""
+    if filters is None:
+        return b""
+    raw = filters.encode("utf-8")
+    if len(raw) > 0xFFFF:
+        raise ValueError("filter text exceeds 65535 utf-8 bytes")
+    return struct.pack("!H", len(raw)) + raw
+
+
+def _take_filters(payload: bytes, off: int,
+                  what: str) -> Tuple[Optional[str], int]:
+    """Parse the optional trailing predicate field at `off`: absent
+    (frame ends exactly there) -> (None, off); present -> (text, end).
+    Truncation inside the field REJECTS — a frame either carries the
+    whole field or none of it."""
+    if off == len(payload):
+        return None, off
+    if off + 2 > len(payload):
+        raise FrameError(f"{what} truncated inside the filter length")
+    (ln,) = struct.unpack_from("!H", payload, off)
+    off += 2
+    if off + ln > len(payload):
+        raise FrameError(f"{what} truncated inside the filter text")
+    try:
+        text = payload[off: off + ln].decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise FrameError(f"{what} filter text is not utf-8: {e}") from None
+    return text, off + ln
 
 
 def encode_query(req_id: int, queries: Sequence[str], k: int = 0,
-                 nprobe: int = 0, deadline_ms: float = 0.0) -> bytes:
+                 nprobe: int = 0, deadline_ms: float = 0.0,
+                 filters: Optional[str] = None) -> bytes:
     if not 0 < len(queries) <= 0xFFFF:
         raise ValueError(f"1..65535 queries per frame, got {len(queries)}")
     parts = [_QUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
@@ -231,6 +278,7 @@ def encode_query(req_id: int, queries: Sequence[str], k: int = 0,
             raise ValueError("query text exceeds 65535 utf-8 bytes")
         parts.append(struct.pack("!H", len(raw)))
         parts.append(raw)
+    parts.append(_filters_field(filters))
     return b"".join(parts)
 
 
@@ -252,14 +300,17 @@ def decode_query(payload: bytes) -> QueryRequest:
         except UnicodeDecodeError as e:
             raise FrameError(f"query text is not utf-8: {e}") from None
         off += ln
+    filters, off = _take_filters(payload, off, "query frame")
     if off != len(payload):
         raise FrameError(f"{len(payload) - off} trailing bytes after the "
                          "last query")
-    return QueryRequest(req_id, deadline_ms, k, nprobe, tuple(queries))
+    return QueryRequest(req_id, deadline_ms, k, nprobe, tuple(queries),
+                        filters)
 
 
 def encode_vquery(req_id: int, qv: np.ndarray, k: int = 0, nprobe: int = 0,
-                  deadline_ms: float = 0.0) -> bytes:
+                  deadline_ms: float = 0.0,
+                  filters: Optional[str] = None) -> bytes:
     qv = np.ascontiguousarray(qv, dtype="<f4")
     if qv.ndim != 2 or not 0 < qv.shape[0] <= 0xFFFF \
             or not 0 < qv.shape[1] <= 0xFFFF:
@@ -267,7 +318,7 @@ def encode_vquery(req_id: int, qv: np.ndarray, k: int = 0, nprobe: int = 0,
                          f"got {qv.shape}")
     return (_VQUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
                               int(nprobe), qv.shape[0], qv.shape[1])
-            + qv.tobytes())
+            + qv.tobytes() + _filters_field(filters))
 
 
 def _block_to_qv(block, n: int, dim: int, what: str) -> np.ndarray:
@@ -289,28 +340,42 @@ def decode_vquery(payload: bytes) -> VectorRequest:
     if len(payload) < _VQUERY_HEAD.size:
         raise FrameError("vquery frame shorter than its fixed header")
     req_id, deadline_ms, k, nprobe, n, dim = _VQUERY_HEAD.unpack_from(payload)
-    qv = _block_to_qv(memoryview(payload)[_VQUERY_HEAD.size:], n, dim,
+    cut = _VQUERY_HEAD.size + n * dim * 4
+    if len(payload) < cut:
+        raise FrameError(f"vquery block carries "
+                         f"{len(payload) - _VQUERY_HEAD.size} bytes for a "
+                         f"[{n}, {dim}] f32 matrix ({n * dim * 4} expected)")
+    qv = _block_to_qv(memoryview(payload)[_VQUERY_HEAD.size: cut], n, dim,
                       "vquery block")
-    return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
+    filters, off = _take_filters(payload, cut, "vquery frame")
+    if off != len(payload):
+        raise FrameError(f"{len(payload) - off} trailing bytes after a "
+                         "vquery filter field")
+    return VectorRequest(req_id, deadline_ms, k, nprobe, qv, filters)
 
 
 def encode_vquery_put(req_id: int, slot: int, block: bytes, n: int,
                       dim: int, k: int = 0, nprobe: int = 0,
-                      deadline_ms: float = 0.0) -> bytes:
+                      deadline_ms: float = 0.0,
+                      filters: Optional[str] = None) -> bytes:
     """A VQUERY that also interns its (already encoded) query block into
-    the receiver's per-connection cache slot `slot`."""
+    the receiver's per-connection cache slot `slot`. The filter field
+    (present only when `filters` is not None) is PER REQUEST — it rides
+    after the block and is never interned with it."""
     return (_VQUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
                               int(nprobe), n, dim)
-            + _SLOT.pack(slot) + block)
+            + _SLOT.pack(slot) + block + _filters_field(filters))
 
 
 def encode_vquery_ref(req_id: int, slot: int, n: int, dim: int,
                       k: int = 0, nprobe: int = 0,
-                      deadline_ms: float = 0.0) -> bytes:
+                      deadline_ms: float = 0.0,
+                      filters: Optional[str] = None) -> bytes:
     """A VQUERY whose block was interned earlier on this connection: the
     per-request head plus a 2-byte slot id instead of n*dim*4 raw f32."""
     return (_VQUERY_HEAD.pack(req_id, float(deadline_ms), int(k),
-                              int(nprobe), n, dim) + _SLOT.pack(slot))
+                              int(nprobe), n, dim) + _SLOT.pack(slot)
+            + _filters_field(filters))
 
 
 def decode_vquery_any(ftype: int, payload: bytes,
@@ -336,23 +401,34 @@ def decode_vquery_any(ftype: int, payload: bytes,
                          "negotiated compression")
     off = _VQUERY_HEAD.size + _SLOT.size
     if ftype == T_VQUERY_PUT:
-        block = bytes(memoryview(payload)[off:])
+        cut = off + n * dim * 4
+        if len(payload) < cut:
+            raise FrameError(f"interned vquery block carries "
+                             f"{len(payload) - off} bytes for a "
+                             f"[{n}, {dim}] f32 matrix "
+                             f"({n * dim * 4} expected)")
+        block = bytes(memoryview(payload)[off: cut])
         qv = _block_to_qv(block, n, dim, "interned vquery block")
+        filters, end = _take_filters(payload, cut, "interned vquery frame")
+        if end != len(payload):
+            raise FrameError(f"{len(payload) - end} trailing bytes after "
+                             "an interned vquery filter field")
         slots[slot] = block
-        return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
+        return VectorRequest(req_id, deadline_ms, k, nprobe, qv, filters)
     if ftype != T_VQUERY_REF:
         # the explicit REF branch (not a fall-through): a future vquery
         # variant routed here by mistake must REJECT, not silently parse
         # as a slot reference
         raise FrameError(f"frame type {ftype} is not a vquery")
-    if len(payload) != off:
-        raise FrameError(f"{len(payload) - off} trailing bytes after a "
+    filters, end = _take_filters(payload, off, "vquery slot reference")
+    if end != len(payload):
+        raise FrameError(f"{len(payload) - end} trailing bytes after a "
                          "vquery slot reference")
     block = slots.get(slot)
     if block is None:
         raise FrameError(f"vquery references empty intern slot {slot}")
     qv = _block_to_qv(block, n, dim, "interned vquery block")
-    return VectorRequest(req_id, deadline_ms, k, nprobe, qv)
+    return VectorRequest(req_id, deadline_ms, k, nprobe, qv, filters)
 
 
 def encode_result(req_id: int, scores: np.ndarray, ids: np.ndarray,
@@ -944,17 +1020,25 @@ class SocketSearchClient:
     once the server confirms, `cache_lookup()` probes its result cache
     and `cache_put()` shares a computed row into it — the peering calls
     the fleet cache rides on (docs/SERVING.md "Result cache"). Against a
-    server that does not confirm the flag, both degrade to no-ops."""
+    server that does not confirm the flag, both degrade to no-ops.
+
+    With `filters` (the default) the HELLO also advertises FLAG_FILTERS:
+    once the server confirms, `search`/`search_raw`/`topk_vectors`
+    accept a `filters` predicate that rides the frame's optional
+    trailing field. Passing a predicate to a server that never
+    confirmed the flag raises RemoteError — the client refuses to
+    silently serve unfiltered results for a filtered request."""
 
     def __init__(self, host: str, port: int, deadline_ms: float = 0.0,
                  timeout_s: float = 30.0, compress: bool = True,
-                 result_cache: bool = False):
+                 result_cache: bool = False, filters: bool = True):
         self.host = host
         self.port = int(port)
         self.deadline_ms = float(deadline_ms)
         self.timeout_s = float(timeout_s)
         self.compress = bool(compress)
         self.result_cache = bool(result_cache)
+        self.filters = bool(filters)
         self._local = threading.local()
         self._lock = threading.Lock()
         self._conns: List[socket.socket] = []   # guarded-by: _lock
@@ -973,7 +1057,8 @@ class SocketSearchClient:
         sender = FrameSender(sock)
         flags = 0
         want = ((FLAG_WIRE_COMPRESS if self.compress else 0)
-                | (FLAG_RESULT_CACHE if self.result_cache else 0))
+                | (FLAG_RESULT_CACHE if self.result_cache else 0)
+                | (FLAG_FILTERS if self.filters else 0))
         with self._lock:
             attempt_hello = bool(want) and not self._legacy_server
         if attempt_hello:
@@ -1049,37 +1134,56 @@ class SocketSearchClient:
             except OSError:
                 pass
 
+    def _filters_text(self, filters) -> Optional[str]:
+        """Normalize a filters argument (None / canonical text / a
+        compiled Predicate) and enforce negotiation: a predicate for a
+        server that never confirmed FLAG_FILTERS REJECTS here — shipped
+        unfiltered frames would serve WRONG results silently."""
+        text = getattr(filters, "text", filters)
+        if text is None or text == "":
+            return None
+        _, _, flags, _ = self._conn()
+        if not flags & FLAG_FILTERS:
+            raise RemoteError("server did not negotiate filtered queries "
+                              "(FLAG_FILTERS)")
+        return str(text)
+
     def search(self, query: str, k: Optional[int] = None,
                nprobe: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> List[Dict]:
+               deadline_ms: Optional[float] = None,
+               filters=None) -> List[Dict]:
         """One text query over the wire -> the same [{page_id, score}]
         shape a local `SearchService.search` returns (snippets stay
         server-side; the wire carries scores/ids)."""
         scores, ids, _ = self.search_raw([query], k=k, nprobe=nprobe,
-                                         deadline_ms=deadline_ms)
+                                         deadline_ms=deadline_ms,
+                                         filters=filters)
         return [{"page_id": int(i), "score": float(s)}
                 for s, i in zip(scores[0], ids[0]) if i >= 0]
 
     def search_raw(self, queries: Sequence[str], k: Optional[int] = None,
                    nprobe: Optional[int] = None,
-                   deadline_ms: Optional[float] = None
-                   ) -> Tuple[np.ndarray, np.ndarray, int]:
+                   deadline_ms: Optional[float] = None,
+                   filters=None) -> Tuple[np.ndarray, np.ndarray, int]:
         req_id = next_request_id()
         dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
         payload = encode_query(req_id, list(queries), k=k or 0,
-                               nprobe=nprobe or 0, deadline_ms=dl)
+                               nprobe=nprobe or 0, deadline_ms=dl,
+                               filters=self._filters_text(filters))
         return self._roundtrip(T_QUERY, (payload,), req_id)
 
     def topk_vectors(self, qv: np.ndarray, k: Optional[int] = None,
                      nprobe: Optional[int] = None,
-                     deadline_ms: Optional[float] = None
-                     ) -> Tuple[np.ndarray, np.ndarray, int]:
+                     deadline_ms: Optional[float] = None,
+                     filters=None) -> Tuple[np.ndarray, np.ndarray, int]:
         """Raw vector retrieval over the wire (the model-free twin of
         `SearchService.topk_vectors`): (scores, ids, scan_bytes). On a
         compressing connection the query block interns — a repeated
-        block ships once and costs a 2-byte slot reference after."""
+        block ships once and costs a 2-byte slot reference after; the
+        filter field rides per request, never with the interned block."""
         req_id = next_request_id()
         dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        ftext = self._filters_text(filters)
         block = np.ascontiguousarray(qv, dtype="<f4")
         if block.ndim != 2 or not 0 < block.shape[0] <= 0xFFFF \
                 or not 0 < block.shape[1] <= 0xFFFF:
@@ -1092,13 +1196,14 @@ class SocketSearchClient:
             slot, fresh = intern.slot_for(key)
             head = _VQUERY_HEAD.pack(req_id, dl, int(k or 0),
                                      int(nprobe or 0), n, dim)
+            tail = _filters_field(ftext)
             if fresh:
-                parts = (head, _SLOT.pack(slot), key)
+                parts = (head, _SLOT.pack(slot), key, tail)
                 return self._roundtrip(T_VQUERY_PUT, parts, req_id)
             return self._roundtrip(T_VQUERY_REF,
-                                   (head, _SLOT.pack(slot)), req_id)
+                                   (head, _SLOT.pack(slot), tail), req_id)
         payload = encode_vquery(req_id, block, k=k or 0, nprobe=nprobe or 0,
-                                deadline_ms=dl)
+                                deadline_ms=dl, filters=ftext)
         return self._roundtrip(T_VQUERY, (payload,), req_id)
 
     # -- fleet result-cache peering (docs/SERVING.md "Result cache") -------

@@ -589,6 +589,38 @@ class VectorStore:
             "compacted_through": self.compacted_through,
         }
 
+    # -- per-row attributes (index/attrs.py, docs/ANN.md) ------------------
+    @property
+    def attrs_enabled(self) -> bool:
+        """Whether this store carries a per-row attribute table. Decided at
+        init_attrs() time and recorded in the manifest (bit-field layout
+        version included); shards written before the flag flipped simply
+        have no `.atr.npy` file and read back as all-zero words."""
+        return "attrs" in self.manifest
+
+    def init_attrs(self) -> None:
+        """Initialize (or validate) the store's attribute table: record the
+        versioned bit-field layout in the manifest so every subsequent
+        shard write — base embed, appends, compaction, migration — carries
+        one packed uint32 attribute word per row through the same
+        bytes+CRC32 integrity machinery as the vectors themselves."""
+        from dnn_page_vectors_tpu.index import attrs as attrs_mod
+        if self.attrs_enabled:
+            attrs_mod.check_attrs_section(self.manifest["attrs"])
+            return
+        self.manifest["attrs"] = attrs_mod.attrs_manifest_section()
+        self._flush_manifest()
+
+    def load_attrs(self, entry: Dict) -> np.ndarray:
+        """One shard's packed attribute words (uint32 [count]). Shards
+        written before init_attrs() (no `.atr.npy`) read as all-zero words
+        — a well-defined attribute value, so predicates stay total."""
+        if "atr" not in entry:
+            return np.zeros(int(entry["count"]), np.uint32)
+        faults.active().check("shard_read")
+        return np.ascontiguousarray(
+            np.load(os.path.join(self.directory, entry["atr"])), np.uint32)
+
     # -- ANN index directory pointer (docs/MAINTENANCE.md) -----------------
     @property
     def index_dirname(self) -> str:
@@ -702,7 +734,7 @@ class VectorStore:
         generation."""
         import shutil
         for s in self.shards():
-            for key in ("vec", "ids", "scl"):
+            for key in ("vec", "ids", "scl", "atr"):
                 try:
                     os.remove(os.path.join(self.directory, s[key]))
                 except (FileNotFoundError, KeyError):
@@ -734,7 +766,7 @@ class VectorStore:
         first (existence, recorded byte size — catches truncation with one
         stat) then the CRC32 re-read. Entries from stores predating the
         integrity record (no "crc" key) pass, as they always did."""
-        for key in ("vec", "ids", "scl"):
+        for key in ("vec", "ids", "scl", "atr"):
             if key not in entry:
                 continue
             path = os.path.join(self.directory, entry[key])
@@ -761,7 +793,7 @@ class VectorStore:
         completed_shards(), so the next embed_corpus resume re-embeds
         exactly this id-range."""
         idx = entry["index"]
-        for key in ("vec", "ids", "scl"):
+        for key in ("vec", "ids", "scl", "atr"):
             if key in entry:
                 src = os.path.join(self.directory, entry[key])
                 try:
@@ -824,7 +856,8 @@ class VectorStore:
     def write_shard(self, index: int, ids: np.ndarray,
                     vecs: Optional[np.ndarray] = None, *,
                     codes: Optional[np.ndarray] = None,
-                    scales: Optional[np.ndarray] = None) -> None:
+                    scales: Optional[np.ndarray] = None,
+                    attrs: Optional[np.ndarray] = None) -> None:
         """Persist one shard. Either `vecs` (float rows; quantized here when
         the store is int8) or, for int8 stores, pre-quantized
         `codes`+`scales` straight off the device (bulk_embed's on-device
@@ -837,7 +870,8 @@ class VectorStore:
         at any point either leaves the shard unrecorded (re-embedded on
         resume) or recorded with all its bytes on disk; never recorded
         without them."""
-        entry = self._write_shard_files("", index, ids, vecs, codes, scales)
+        entry = self._write_shard_files("", index, ids, vecs, codes, scales,
+                                        attrs=attrs)
         if self._writer_path is not None:
             self._writer_shards = (
                 [s for s in self._writer_shards if s["index"] != index]
@@ -861,11 +895,14 @@ class VectorStore:
         self._flush_manifest()
 
     def _write_shard_files(self, subdir: str, index: int, ids: np.ndarray,
-                           vecs, codes, scales) -> Dict:
+                           vecs, codes, scales, attrs=None) -> Dict:
         """Durably write one shard's data files (under `subdir` relative to
         the store root; "" = the base layout) and return its manifest entry
         with byte sizes + CRC32s recorded — the shared core of base
-        write_shard and GenerationWriter appends."""
+        write_shard and GenerationWriter appends. On an attrs-enabled store
+        (init_attrs) every shard also lands a `.atr.npy` of packed uint32
+        attribute words — `attrs` aligned with `ids` pre-padding, zeros
+        when the producer has none — under the same CRC record."""
         data = vecs if codes is None else codes
         if data.shape[-1] != self.dim:
             raise ValueError(f"vectors are {data.shape[-1]}-d, store is "
@@ -874,10 +911,24 @@ class VectorStore:
             raise ValueError("pre-quantized codes require an int8 store")
         keep = ids >= 0  # drop batch padding rows
         ids = ids[keep]
+        if attrs is not None and not self.attrs_enabled:
+            raise ValueError("attrs given but the store has no attribute "
+                             "table; call init_attrs() first")
+        if self.attrs_enabled:
+            attr_words = (np.zeros(keep.shape[0], np.uint32) if attrs is None
+                          else np.asarray(attrs, np.uint32))
+            if attr_words.shape[0] != keep.shape[0]:
+                raise ValueError(
+                    f"attrs has {attr_words.shape[0]} rows, ids has "
+                    f"{keep.shape[0]}")
+            attr_words = attr_words[keep]
+        else:
+            attr_words = None
         d = os.path.join(self.directory, subdir) if subdir else self.directory
         vpath = os.path.join(d, f"shard_{index:05d}.vec.npy")
         ipath = os.path.join(d, f"shard_{index:05d}.ids.npy")
         spath = os.path.join(d, f"shard_{index:05d}.scl.npy")
+        apath = os.path.join(d, f"shard_{index:05d}.atr.npy")
         rel = (lambda p: os.path.join(subdir, os.path.basename(p))
                if subdir else os.path.basename(p))
         entry = {"index": index, "count": int(ids.shape[0]),
@@ -909,14 +960,21 @@ class VectorStore:
             else:
                 np.save(vpath, vecs[keep].astype(np.float16))
             np.save(ipath, ids.astype(np.int64))
+            if attr_words is not None:
+                np.save(apath, attr_words.astype("<u4"))
+                entry["atr"] = rel(apath)
             # integrity record: byte size + CRC32 of each data file, taken
             # from the bytes just written — the manifest carries what the
             # files MUST look like, so verify()/staging can tell truncation
             # and bit rot from legitimate data forever after
-            files = [vpath, ipath, spath] if "scl" in entry else [vpath, ipath]
+            pairs = [("vec", vpath), ("ids", ipath)]
+            if "scl" in entry:
+                pairs.append(("scl", spath))
+            if "atr" in entry:
+                pairs.append(("atr", apath))
             entry["bytes"] = {}
             entry["crc"] = {}
-            for key, path in zip(("vec", "ids", "scl"), files):
+            for key, path in pairs:
                 entry["bytes"][key] = os.path.getsize(path)
                 entry["crc"][key] = _crc_file(path)
                 self._fsync_file(path)
@@ -1043,12 +1101,14 @@ class GenerationWriter:
     def write_shard(self, ids: np.ndarray,
                     vecs: Optional[np.ndarray] = None, *,
                     codes: Optional[np.ndarray] = None,
-                    scales: Optional[np.ndarray] = None) -> Dict:
+                    scales: Optional[np.ndarray] = None,
+                    attrs: Optional[np.ndarray] = None) -> Dict:
         """Persist one appended shard (same vecs/codes contract as
         VectorStore.write_shard); the shard index is assigned here."""
         index = self._next_index
         entry = self.store._write_shard_files(
-            os.path.basename(self._dir), index, ids, vecs, codes, scales)
+            os.path.basename(self._dir), index, ids, vecs, codes, scales,
+            attrs=attrs)
         entry["gen"] = self.gen
         kept = np.asarray(ids)[np.asarray(ids) >= 0]
         entry["id_lo"] = int(kept.min()) if kept.size else self._id_cursor

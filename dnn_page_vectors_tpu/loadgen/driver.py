@@ -134,9 +134,13 @@ class BalancedClient:
             self._sent[i] += 1
             return i
 
-    def search(self, query, k: int = 10, nprobe: Optional[int] = None):
+    def search(self, query, k: int = 10, nprobe: Optional[int] = None,
+               filters=None):
         i = self._pick()
         try:
+            if filters is not None:
+                return self.clients[i].search(query, k=k, nprobe=nprobe,
+                                              filters=filters)
             return self.clients[i].search(query, k=k, nprobe=nprobe)
         except Exception:
             with self._lock:
@@ -195,13 +199,20 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
     errors = 0
     sheds = 0
     err_lock = threading.Lock()
+    # per-scenario client-side latency samples under a filtered mix
+    # (docs/ANN.md "Filtered retrieval"): the registry can't attribute a
+    # window sample to a predicate, so the scenario block is the one
+    # place the driver measures with its own clock — labeled as such
+    scen_lat: Dict[str, List[float]] = {}
     issue_to = client if client is not None else svc
 
     def _issue(req):
         nonlocal errors, sheds
+        kw = {"filters": req.filters} if req.filters else {}
+        t_req = clock()
         try:
             issue_to.search(queries[req.query_id % len(queries)], k=req.k,
-                            nprobe=req.nprobe)
+                            nprobe=req.nprobe, **kw)
         except DeadlineExceeded:
             # an admission shed is an availability decision the trial
             # reports separately, not a server error
@@ -210,6 +221,11 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
         except Exception:  # noqa: BLE001 — errors are a trial METRIC
             with err_lock:
                 errors += 1
+        else:
+            if req.scenario is not None:
+                with err_lock:
+                    scen_lat.setdefault(req.scenario, []).append(
+                        clock() - t_req)
 
     total_s = float(warmup_s) + float(duration_s)
     t0 = clock()
@@ -372,6 +388,21 @@ def run_trial(svc, workload: Workload, offered: float, queries: Sequence[str],
             "entries": rcache1.get("entries", 0),
             "bytes": rcache1.get("bytes", 0),
         }
+    if scen_lat:
+        # filtered-mix block (docs/ANN.md "Filtered retrieval"): one row
+        # per scenario — CLIENT-side latency around the issue call (the
+        # registry's window p99 stays the headline; this block only
+        # attributes load across predicates)
+        import numpy as _np
+        rec["filter_scenarios"] = {
+            name: {
+                "requests": len(lat),
+                "qps": round(len(lat) / max(total_s, 1e-9), 2),
+                "p50_ms": round(
+                    float(_np.percentile(lat, 50)) * 1000.0, 3),
+                "p99_ms": round(
+                    float(_np.percentile(lat, 99)) * 1000.0, 3),
+            } for name, lat in sorted(scen_lat.items())}
     if schedule_digest is not None:
         rec["schedule_digest"] = schedule_digest
     if mutator is not None:

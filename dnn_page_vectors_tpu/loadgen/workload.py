@@ -49,6 +49,19 @@ import numpy as np
 Profile = Sequence[Tuple[int, Optional[int], float]]
 DEFAULT_PROFILE: Profile = ((10, None, 1.0),)
 
+# one (scenario name, predicate text or None, weight) entry — the
+# filtered-query mix `cli loadtest --filters` arms (docs/ANN.md "Filtered
+# retrieval"). The default predicates all match the all-zero attribute
+# word, so the mix exercises the filtered scan path even on a store whose
+# shards predate init_attrs().
+FilterScenarios = Sequence[Tuple[str, Optional[str], float]]
+DEFAULT_FILTER_SCENARIOS: FilterScenarios = (
+    ("unfiltered", None, 0.5),
+    ("lang", "lang==0", 0.25),
+    ("site", "site in {0}", 0.15),
+    ("recent", "recency>=0", 0.10),
+)
+
 SHAPES = ("poisson", "burst", "closed")
 
 
@@ -63,11 +76,14 @@ def _rng(seed: int, *parts) -> np.random.Generator:
 
 @dataclasses.dataclass(frozen=True)
 class Request:
-    """One offered request: which distinct query, and its (k, nprobe)
-    drawn from the workload's profile."""
+    """One offered request: which distinct query, its (k, nprobe) drawn
+    from the workload's profile, and — under a filtered mix — the
+    scenario name plus the canonical predicate text it carries."""
     query_id: int
     k: int
     nprobe: Optional[int] = None
+    filters: Optional[str] = None
+    scenario: Optional[str] = None
 
 
 class QueryMix:
@@ -78,7 +94,8 @@ class QueryMix:
     """
 
     def __init__(self, distinct: int, alpha: float = 1.1,
-                 profile: Profile = DEFAULT_PROFILE):
+                 profile: Profile = DEFAULT_PROFILE,
+                 filter_scenarios: Optional[FilterScenarios] = None):
         self.distinct = max(1, int(distinct))
         self.alpha = float(alpha)
         self.profile = tuple(
@@ -88,12 +105,36 @@ class QueryMix:
         self._p = p / p.sum()
         w = np.asarray([w for _, _, w in self.profile], np.float64)
         self._pw = w / w.sum()
+        # filtered-query scenarios (docs/ANN.md "Filtered retrieval"):
+        # predicate texts canonicalize at construction so every request
+        # of one scenario carries ONE exact text — the form the result
+        # cache keys on. None = the pre-filters sampler, byte-identical
+        # request streams included (no extra RNG draws).
+        self.scenarios: Optional[Tuple[Tuple[str, Optional[str], float],
+                                       ...]] = None
+        self._ps = None
+        if filter_scenarios is not None:
+            from dnn_page_vectors_tpu.index import attrs as attrs_mod
+            self.scenarios = tuple(
+                (str(name),
+                 None if pred is None
+                 else attrs_mod.Predicate.parse(pred).text,
+                 float(w))
+                for name, pred, w in filter_scenarios)
+            ws = np.asarray([w for _, _, w in self.scenarios], np.float64)
+            self._ps = ws / ws.sum()
 
     def sample(self, rng: np.random.Generator, n: int) -> List[Request]:
         qids = rng.choice(self.distinct, size=n, p=self._p)
         prof = rng.choice(len(self.profile), size=n, p=self._pw)
-        return [Request(int(q), self.profile[j][0], self.profile[j][1])
-                for q, j in zip(qids, prof)]
+        if self.scenarios is None:
+            return [Request(int(q), self.profile[j][0], self.profile[j][1])
+                    for q, j in zip(qids, prof)]
+        scen = rng.choice(len(self.scenarios), size=n, p=self._ps)
+        return [Request(int(q), self.profile[j][0], self.profile[j][1],
+                        filters=self.scenarios[s][1],
+                        scenario=self.scenarios[s][0])
+                for q, j, s in zip(qids, prof, scen)]
 
 
 class Workload:
@@ -122,7 +163,11 @@ class Workload:
         same seed must report the same digest."""
         h = hashlib.sha256()
         for t, req in schedule:
-            h.update(f"{t:.6f}:{req.query_id}:{req.k}:{req.nprobe};"
+            # the scenario tag folds in only for FILTERED requests, so an
+            # unfiltered schedule's digest is byte-identical to the
+            # pre-filters format
+            scen = f":{req.scenario}" if req.filters else ""
+            h.update(f"{t:.6f}:{req.query_id}:{req.k}:{req.nprobe}{scen};"
                      .encode())
         return h.hexdigest()[:16]
 
@@ -253,9 +298,12 @@ class Mutator:
 def make_workload(shape: str, *, seed: int = 0, distinct: int = 64,
                   alpha: float = 1.1, profile: Profile = DEFAULT_PROFILE,
                   on_s: float = 0.5, off_s: float = 0.5,
-                  think_s: float = 0.0) -> Workload:
+                  think_s: float = 0.0,
+                  filter_scenarios: Optional[FilterScenarios] = None
+                  ) -> Workload:
     """One factory for the CLI/bench/driver: shape name -> Workload."""
-    mix = QueryMix(distinct, alpha=alpha, profile=profile)
+    mix = QueryMix(distinct, alpha=alpha, profile=profile,
+                   filter_scenarios=filter_scenarios)
     if shape == "poisson":
         return PoissonWorkload(mix, seed=seed)
     if shape == "burst":

@@ -123,6 +123,18 @@ def compact_store(store, registry=None) -> Dict:
             got = raw_cache[pos] = (vecs, scl)
         return got
 
+    # attribute words ride the fold untouched (docs/ANN.md "Filtered
+    # retrieval"): compaction moves rows, never re-derives attributes —
+    # pre-attrs shards contribute their all-zero default words
+    has_attrs = store.attrs_enabled
+    attrs_cache: Dict[int, np.ndarray] = {}
+
+    def _attr_words(pos: int) -> np.ndarray:
+        got = attrs_cache.get(pos)
+        if got is None:
+            got = attrs_cache[pos] = store.load_attrs(old_entries[pos])
+        return got
+
     plan = faults.active()
     new_entries = []
     next_idx = store._next_shard_index()
@@ -134,19 +146,24 @@ def compact_store(store, registry=None) -> Dict:
         n = int(ids_c.size)
         data = np.empty((n, store.dim), np.int8 if is_int8 else np.float16)
         scl_c = np.empty((n,), np.float16) if is_int8 else None
+        atr_c = np.empty((n,), np.uint32) if has_attrs else None
         for pos in np.unique(src_c):
             m = src_c == pos
             vecs, scl = _raw(int(pos))
             data[m] = np.asarray(vecs[row_c[m]])
             if scl_c is not None:
                 scl_c[m] = np.asarray(scl[row_c[m]])
+            if atr_c is not None:
+                atr_c[m] = _attr_words(int(pos))[row_c[m]]
         plan.check("compact_write")
         if is_int8:
             entry = store._write_shard_files(subdir, next_idx, ids_c,
-                                             None, data, scl_c)
+                                             None, data, scl_c,
+                                             attrs=atr_c)
         else:
             entry = store._write_shard_files(subdir, next_idx, ids_c,
-                                             data, None, None)
+                                             data, None, None,
+                                             attrs=atr_c)
         entry["gen"] = epoch         # masked only by LATER tombstones
         entry["id_lo"] = int(ids_c.min())
         entry["id_hi"] = int(ids_c.max()) + 1
@@ -175,13 +192,13 @@ def compact_store(store, registry=None) -> Dict:
     stale_dirs = [store._gen_path(g) for g in range(prev_epoch + 1,
                                                     epoch + 1)]
     old_subdirs = {os.path.dirname(e[k]) for e in old_entries
-                   for k in ("vec", "ids", "scl") if k in e}
+                   for k in ("vec", "ids", "scl", "atr") if k in e}
     stale_dirs += [os.path.join(store.directory, sd)
                    for sd in sorted(old_subdirs - {"", subdir})
                    if sd.startswith(("compact-", "migrate-"))]
     stale_files = [os.path.join(store.directory, e[k])
                    for e in old_entries
-                   for k in ("vec", "ids", "scl")
+                   for k in ("vec", "ids", "scl", "atr")
                    if k in e and os.path.dirname(e[k]) == ""]
     new_bytes = sum(_entry_bytes(e) for e in new_entries)
     seconds = time.perf_counter() - t0
@@ -223,7 +240,7 @@ def purge_stale(store, stats: Dict) -> Dict:
     references, and never leaves the store directory."""
     referenced = {os.path.normpath(os.path.join(store.directory, e[k]))
                   for e in store.shards()
-                  for k in ("vec", "ids", "scl") if k in e}
+                  for k in ("vec", "ids", "scl", "atr") if k in e}
     removed_dirs, removed_files = 0, 0
     root = os.path.normpath(store.directory)
     for path in stats.get("stale_dirs", []):

@@ -41,7 +41,7 @@ from dnn_page_vectors_tpu.utils import faults, telemetry
 
 def _entry_paths(store, entry: Dict) -> List[str]:
     return [os.path.join(store.directory, entry[k])
-            for k in ("vec", "ids", "scl") if k in entry]
+            for k in ("vec", "ids", "scl", "atr") if k in entry]
 
 
 class MigrationPlan:
@@ -152,9 +152,13 @@ class MigrationPlan:
             # count, and id-range — tombstones keep masking at read time
             ids = np.load(os.path.join(store.directory, e["ids"]))
             vecs = self._embed_ids(ids)
+            # attributes are invariant under re-embedding (they describe
+            # the PAGE, not the vector): copy the source shard's words
+            # verbatim — pre-attrs shards carry their all-zero default
+            words = (store.load_attrs(e) if store.attrs_enabled else None)
             plan.check("migrate_write")
             entry = store._write_shard_files(subdir, int(e["index"]), ids,
-                                             vecs, None, None)
+                                             vecs, None, None, attrs=words)
             for k in ("gen", "id_lo", "id_hi"):
                 if k in e:
                     entry[k] = e[k]
@@ -283,7 +287,7 @@ class MigrationPlan:
         store = self.store
         referenced = {os.path.normpath(os.path.join(store.directory, e[k]))
                       for e in store.shards()
-                      for k in ("vec", "ids", "scl") if k in e}
+                      for k in ("vec", "ids", "scl", "atr") if k in e}
         for name in os.listdir(d):
             p = os.path.normpath(os.path.join(d, name))
             if p not in referenced:

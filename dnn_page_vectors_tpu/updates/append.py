@@ -33,12 +33,19 @@ def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
                   tombstone: Iterable[int] = (),
                   update_ids: Iterable[int] = (),
                   batch_size: Optional[int] = None,
-                  log=None, lease: bool = True) -> Dict:
+                  log=None, lease: bool = True,
+                  attrs: Optional[int] = None) -> Dict:
     """Embed corpus pages [start, stop) — default: everything past the
     store's append cursor — plus `update_ids` (existing pages re-embedded
     with fresh text) into a new generation; `tombstone` page ids are
     deleted outright. Updated ids are tombstoned automatically, so their
     old rows mask out while the new rows serve.
+
+    `attrs` (docs/ANN.md "Filtered retrieval"): one packed uint32
+    attribute word (`index/attrs.pack_word`) stamped on EVERY row this
+    append writes — the batch-level grain `cli append --attrs` exposes.
+    Requires an attrs-enabled store (`init_attrs()`); on a store with no
+    attribute table the refusal happens before any embedding work.
 
     Multi-writer safety (docs/MAINTENANCE.md): the whole cursor-read →
     embed → commit window runs under a per-writer append lease
@@ -57,6 +64,11 @@ def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
         raise ValueError(
             "store is unstamped (no model_step); run the base 'embed' "
             "before appending — appends must share the base params")
+    if attrs is not None and not store.attrs_enabled:
+        raise ValueError(
+            "append has --attrs but the store has no attribute table; "
+            "initialize one first (cli append --init-attrs, or "
+            "store.init_attrs())")
     upd_cfg = getattr(embedder.cfg, "updates", None)
     held = None
     if lease:
@@ -71,14 +83,16 @@ def append_corpus(embedder: BulkEmbedder, corpus, store: VectorStore,
         store.reload_generations()
     try:
         return _append_leased(embedder, corpus, store, start, stop,
-                              tombstone, update_ids, batch_size, log, held)
+                              tombstone, update_ids, batch_size, log, held,
+                              attrs)
     finally:
         if held is not None:
             held.release()
 
 
 def _append_leased(embedder, corpus, store, start, stop, tombstone,
-                   update_ids, batch_size, log, held) -> Dict:
+                   update_ids, batch_size, log, held,
+                   attrs=None) -> Dict:
     cursor = store.next_page_id()
     start = cursor if start is None else int(start)
     if start < cursor:
@@ -114,7 +128,9 @@ def _append_leased(embedder, corpus, store, start, stop, tombstone,
             vecs = embedder.embed_texts(
                 [corpus.page_text(int(i)) for i in ids], tower="page",
                 batch_size=bs)
-            writer.write_shard(ids, vecs)
+            words = (np.full(ids.shape[0], int(attrs), np.uint32)
+                     if attrs is not None else None)
+            writer.write_shard(ids, vecs, attrs=words)
             if held is not None:
                 # a long append must not outlive its own lease: renew per
                 # shard; LeaseLost here aborts before a double-assigned

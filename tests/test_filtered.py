@@ -1,0 +1,652 @@
+"""Filtered retrieval (docs/ANN.md "Filtered retrieval"): the per-row
+attribute substrate and the predicate-intersected scan must be an
+OPTIMIZATION over post-filtering, never a different answer — filtered
+results byte-identical to the single-process filtered oracle at every
+tested topology (local, P=2/R=2 in-process, socket server, and the
+2-front-end gateway fleet), the predicate codec surviving reject fuzz,
+attributes riding append -> compact -> migrate unchanged, the
+under-filled-probe escalation draining more lists instead of returning
+short, the no-negotiation degrade (a non-filtering worker is simply
+unroutable for filtered requests — the gateway's local filtered view
+answers, never wrong results), and the result cache keying on the
+canonical predicate so a filtered hit never serves an unfiltered
+entry."""
+import threading
+
+import numpy as np
+import pytest
+
+from dnn_page_vectors_tpu.config import get_config
+from dnn_page_vectors_tpu.index import attrs as A
+from dnn_page_vectors_tpu.infer.vector_store import VectorStore
+from dnn_page_vectors_tpu.utils import faults, telemetry
+
+pytestmark = pytest.mark.filt
+
+DIM = 32
+SHARD = 50
+NSHARDS = 6
+ROWS = SHARD * NSHARDS
+
+# predicate arms pinned to the fixture's attribute layout below:
+# lang==1 keeps 1/2 the rows, site in {0} keeps 1/10, recency>=3 keeps
+# the 6 planted rows (one per shard)
+ARMS = (("lang==1", 0.5), ("site in {0}", 0.1), ("recency>=3", 0.02))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    telemetry.reset_default()
+    yield
+    faults.reset()
+    telemetry.reset_default()
+
+
+def _words(n=ROWS):
+    ids = np.arange(n)
+    return A.pack_words(lang=(ids % 2).astype(np.uint32),
+                        site=(ids % 10).astype(np.uint32),
+                        recency=np.where(ids % SHARD == 0, 3,
+                                         0).astype(np.uint32))
+
+
+@pytest.fixture(scope="module")
+def attr_store(tmp_path_factory):
+    """Synthetic 6-shard store with one packed attribute word per row."""
+    sdir = str(tmp_path_factory.mktemp("filtered_store") / "store")
+    rng = np.random.default_rng(0)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    store.ensure_model_step(0)
+    store.init_attrs()
+    words = _words()
+    for si in range(NSHARDS):
+        lo, hi = si * SHARD, (si + 1) * SHARD
+        v = rng.standard_normal((SHARD, DIM)).astype(np.float32)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        store.write_shard(si, np.arange(lo, hi, dtype=np.int64), v,
+                          attrs=words[lo:hi])
+    return VectorStore(sdir)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _qv(n=3, seed=1):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((n, DIM)).astype(np.float32)
+    return q / np.linalg.norm(q, axis=1, keepdims=True)
+
+
+def _fake_embed(queries):
+    out = np.zeros((len(queries), DIM), np.float32)
+    for i, q in enumerate(queries):
+        r = np.random.default_rng(
+            np.frombuffer(q.encode()[:8].ljust(8, b"\0"),
+                          np.uint64)[0] % (2 ** 32))
+        v = r.standard_normal(DIM).astype(np.float32)
+        out[i] = v / np.linalg.norm(v)
+    return out
+
+
+class _StubCorpus:
+    def page_text(self, i):
+        return f"page {i}"
+
+
+def _service(store, mesh, **serve_over):
+    import dataclasses
+
+    from dnn_page_vectors_tpu.infer.partition_host import MeshEmbedder
+    from dnn_page_vectors_tpu.infer.serve import SearchService
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM})
+    if serve_over:
+        cfg = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    **serve_over))
+    svc = SearchService(cfg, MeshEmbedder(mesh), None, store,
+                        preload_hbm_gb=4.0)
+    svc._embed_queries_cached = _fake_embed
+    svc.corpus = _StubCorpus()
+    return svc
+
+
+def _oracle(store, qv, words, pred, k=10):
+    """Exact post-filter top-k over the DEQUANTIZED store rows (the
+    store holds fp16 — comparing against the fp32 originals would
+    charge quantization error to the filter)."""
+    deq = np.concatenate([store._load_entry(e)[1] for e in store.shards()])
+    sc = qv @ np.asarray(deq, np.float32).T
+    keep = pred.matches(words)
+    sc[:, ~keep] = -np.inf
+    order = np.argsort(-sc, axis=1)[:, :k]
+    s = np.take_along_axis(sc, order, axis=1).astype(np.float32)
+    ids = order.astype(np.int64)
+    ids[~np.isfinite(s)] = -1
+    s[~np.isfinite(s)] = -np.inf
+    return s, ids
+
+
+# ---------------------------------------------------------------------------
+# attribute word + predicate codec
+# ---------------------------------------------------------------------------
+
+def test_attr_word_codec_roundtrip():
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        lang = int(rng.integers(0, A.LANG_MAX + 1))
+        site = int(rng.integers(0, A.SITE_MAX + 1))
+        rec = int(rng.integers(0, A.REC_MAX + 1))
+        assert A.unpack_word(A.pack_word(lang=lang, site=site,
+                                         recency=rec)) == (lang, site, rec)
+    # vectorized pack == the scalar loop, little-endian on disk
+    langs = rng.integers(0, A.LANG_MAX + 1, 64).astype(np.uint32)
+    sites = rng.integers(0, A.SITE_MAX + 1, 64).astype(np.uint32)
+    recs = rng.integers(0, A.REC_MAX + 1, 64).astype(np.uint32)
+    vec = A.pack_words(lang=langs, site=sites, recency=recs)
+    assert vec.dtype == A.ATTR_DTYPE
+    assert [int(x) for x in vec] == [
+        A.pack_word(lang=int(a), site=int(b), recency=int(c))
+        for a, b, c in zip(langs, sites, recs)]
+    # a site NAME hashes to a stable bucket; ints pass through
+    assert A.site_bucket("example.org") == A.site_bucket("example.org")
+    assert A.site_bucket(123) == 123
+    assert A.pack_word(site="example.org") == A.pack_word(
+        site=A.site_bucket("example.org"))
+    with pytest.raises(A.FilterError):
+        A.pack_word(lang=A.LANG_MAX + 1)
+    with pytest.raises(A.FilterError):
+        A.pack_words(lang=np.array([0]), site=np.array([A.SITE_MAX + 1]),
+                     recency=np.array([0]))
+
+
+def test_predicate_canonical_form_and_eval():
+    # term order and whitespace never change the canonical text
+    p1 = A.Predicate.parse("site in {3, 1} & lang==2 & recency >= 1")
+    p2 = A.Predicate.parse("recency>=1&lang == 2&site in {1,3}")
+    assert p1.text == p2.text
+    words = A.pack_words(
+        lang=np.array([2, 2, 1, 2], np.uint32),
+        site=np.array([1, 5, 3, 3], np.uint32),
+        recency=np.array([1, 3, 2, 0], np.uint32))
+    assert list(p1.matches(words)) == [True, False, False, False]
+    # host and device evaluation agree bit for bit
+    import jax.numpy as jnp
+    dev = np.asarray(p1.matches_device(jnp.asarray(words)))
+    assert list(dev) == list(p1.matches(words))
+    # recency>=B is a lower bound, not equality
+    pr = A.Predicate.parse("recency>=2")
+    assert list(pr.matches(words)) == [False, True, True, False]
+
+
+def test_predicate_codec_roundtrip_and_reject_fuzz():
+    for text, _ in ARMS + (("lang==2 & site in {1,example.org} "
+                            "& recency>=1", 0),):
+        p = A.Predicate.parse(text)
+        q = A.decode_predicate(p.encode())
+        assert q.text == p.text
+        words = _words(100)
+        assert list(q.matches(words)) == list(p.matches(words))
+    bad = ["", "lang", "lang==", "lang==999", "bogus==1", "site in {",
+           "site in 3", "recency>=99", "lang==1 &", "lang=1",
+           "site in {" + ",".join(map(str, range(65))) + "}",
+           " & ".join(["lang==1"] * 17), "x" * 600]
+    for text in bad:
+        with pytest.raises(A.FilterError):
+            A.Predicate.parse(text)
+    # wire bytes: oversize + seeded garbage must raise FilterError,
+    # never hang or leak a different exception type
+    with pytest.raises(A.FilterError):
+        A.decode_predicate(b"x" * (A.MAX_PREDICATE_BYTES + 1))
+    rng = np.random.default_rng(11)
+    for _ in range(200):
+        blob = rng.integers(0, 256, int(rng.integers(0, 80))).astype(
+            np.uint8).tobytes()
+        try:
+            A.decode_predicate(blob)
+        except A.FilterError:
+            pass
+
+
+def test_parse_attr_assignments():
+    w = A.parse_attr_assignments(["lang=3", "site=wiki.org", "recency=2"])
+    assert A.unpack_word(w) == (3, A.site_bucket("wiki.org"), 2)
+    assert A.parse_attr_assignments(["site=7"]) == A.pack_word(site=7)
+    for bad in (["tag=1"], ["lang"], ["lang=x"], ["recency=99"]):
+        with pytest.raises(A.FilterError):
+            A.parse_attr_assignments(bad)
+
+
+# ---------------------------------------------------------------------------
+# filtered exact path: oracle identity + the scan-bytes contract
+# ---------------------------------------------------------------------------
+
+def test_filtered_exact_matches_post_filter_oracle(attr_store, mesh):
+    svc = _service(attr_store, mesh)
+    words = _words()
+    qv = _qv(4, seed=3)
+    try:
+        for text, _sel in ARMS:
+            pred = A.Predicate.parse(text)
+            os_, oi = _oracle(attr_store, qv, words, pred)
+            s, ids = svc.topk_vectors(qv, k=10, filters=text)
+            assert np.array_equal(ids, oi), text
+            np.testing.assert_allclose(s, os_, rtol=1e-5)
+            # every served row satisfies the predicate
+            for row in ids:
+                live = row[row >= 0]
+                assert pred.matches(words[live]).all()
+        # the text path records one filtered_query event per dispatch
+        res = svc.search("event probe", k=5, filters="lang==1")
+        for r in res:
+            assert A.unpack_word(words[r["page_id"]])[0] == 1
+        ev = svc.registry.events("filtered_query")
+        assert ev and ev[-1]["attrs"]["predicate"] == "lang==1"
+    finally:
+        svc.close()
+
+
+def test_filtered_scan_bytes_contract(attr_store, mesh):
+    """The acceptance gate: at selectivity 0.1 the filtered exact scan
+    reads <= 0.3x the unfiltered exact bytes (attr words + matching
+    rows only), and scan bytes scale DOWN with selectivity."""
+    svc = _service(attr_store, mesh)
+    qv = _qv(1, seed=5)
+    try:
+        _, _, base = svc._topk_view(svc._view, qv, 1, 10, None)
+        scans = {}
+        for text, sel in ARMS:
+            _, _, sb = svc._topk_view(svc._view, qv, 1, 10, None,
+                                      predicate=A.Predicate.parse(text))
+            scans[sel] = sb
+            assert 0 < sb < base
+        assert scans[0.1] <= 0.3 * base
+        assert scans[0.02] < scans[0.1] < scans[0.5]
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# IVF: predicate intersection before ADC + drain-more-lists escalation
+# ---------------------------------------------------------------------------
+
+def test_ivf_filtered_recall_contract(attr_store, mesh):
+    from dnn_page_vectors_tpu.index.ivf import IVFIndex
+    idx = IVFIndex.build(attr_store, mesh, nlist=8, iters=5, seed=0)
+    words = _words()
+    qv = _qv(4, seed=3)
+    for text, _sel in ARMS:
+        pred = A.Predicate.parse(text)
+        _, oi = _oracle(attr_store, qv, words, pred)
+        _, ids, _ = idx.search(qv, 10, nprobe=8, predicate=pred)
+        for q in range(qv.shape[0]):
+            want = set(int(x) for x in oi[q] if x >= 0)
+            got = set(int(x) for x in ids[q] if x >= 0)
+            # full probe: the filtered gather covers every list, so the
+            # >=0.95 recall contract must hold with room to spare
+            assert len(got & want) >= 0.95 * len(want), text
+            assert pred.matches(words[list(got)]).all()
+
+
+def test_ivf_underfilled_probe_escalates(attr_store, mesh):
+    """A selective predicate under a narrow probe must drain more lists
+    (counted) instead of returning a short result set."""
+    from dnn_page_vectors_tpu.index.ivf import IVFIndex
+    idx = IVFIndex.build(attr_store, mesh, nlist=8, iters=5, seed=0)
+    words = _words()
+    pred = A.Predicate.parse("recency>=3")        # 6 rows in 300
+    qv = _qv(3, seed=9)
+    _, ids, st = idx.search(qv, 4, nprobe=1, predicate=pred)
+    assert st.get("filter_escalations", 0) > 0
+    want = set(int(x) for x in np.nonzero(pred.matches(words))[0])
+    for q in range(3):
+        got = [int(x) for x in ids[q] if x >= 0]
+        assert got and set(got) <= want
+    assert telemetry.default_registry().counter(
+        "ivf.filter_escalations").value > 0
+
+
+# ---------------------------------------------------------------------------
+# byte identity across topologies vs the single-process filtered oracle
+# ---------------------------------------------------------------------------
+
+def test_filtered_byte_identity_partitioned_and_socket(attr_store, mesh):
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    from dnn_page_vectors_tpu.infer.transport import SocketSearchClient
+    qv = _qv(6, seed=13)
+    svc1 = _service(attr_store, mesh)
+    base = {t: svc1.topk_vectors(qv, k=10, filters=t) for t, _ in ARMS}
+    svcp = _service(attr_store, mesh, partitions=2, replicas=2)
+    srv_svc = _service(attr_store, mesh)
+    srv = serve_in_background(srv_svc)
+    client = SocketSearchClient(srv.host, srv.port)
+    try:
+        assert svcp.partition_set is not None
+        for text, _ in ARMS:
+            bs, bi = base[text]
+            ps, pi = svcp.topk_vectors(qv, k=10, filters=text)
+            assert np.array_equal(pi, bi), f"P=2 R=2 {text}"
+            assert np.array_equal(ps, bs)
+            ws, wi, _ = client.topk_vectors(qv, k=10, filters=text)
+            assert np.array_equal(wi, bi), f"socket {text}"
+            assert np.array_equal(ws, bs)
+    finally:
+        client.close()
+        srv.close()
+        srv_svc.close()
+        svcp.close()
+        svc1.close()
+
+
+def test_filtered_byte_identity_two_front_ends(attr_store, mesh):
+    """2 front ends x (P=2, R=2) over one shared worker fleet: every
+    filtered answer byte-identical to the single-process filtered
+    oracle captured before any gateway attached."""
+    from dnn_page_vectors_tpu.infer.partition_host import (PartitionWorker,
+                                                           WorkerGateway)
+    over = dict(partitions=2, replicas=2, heartbeat_s=0.5)
+    qv = _qv(4, seed=17)
+    svc0 = _service(attr_store, mesh, **over)
+    oracle = {t: svc0.topk_vectors(qv, k=10, filters=t) for t, _ in ARMS}
+    svc1 = _service(attr_store, mesh, **over)
+    gw0 = WorkerGateway(svc0, heartbeat_s=0.5)
+    svc0.attach_gateway(gw0)
+    gw1 = WorkerGateway(svc1, heartbeat_s=0.5)
+    svc1.attach_gateway(gw1)
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM,
+                                   "serve.partitions": 2,
+                                   "serve.replicas": 2})
+    workers = []
+    try:
+        for p in range(2):
+            for r in range(2):
+                w = PartitionWorker(
+                    cfg, attr_store.directory,
+                    [("127.0.0.1", gw0.port), ("127.0.0.1", gw1.port)],
+                    partition=p, partitions=2, replica=r, mesh=mesh)
+                threading.Thread(target=w.run, daemon=True).start()
+                workers.append(w)
+        assert gw0.wait_for_workers(4, timeout_s=60.0)
+        assert gw1.wait_for_workers(4, timeout_s=60.0)
+        assert gw0.stats()["workers_filtering"] == 4
+        for text, _ in ARMS:
+            bs, bi = oracle[text]
+            for svc in (svc0, svc1):
+                s, ids = svc.topk_vectors(qv, k=10, filters=text)
+                assert np.array_equal(ids, bi), text
+                assert np.array_equal(s, bs)
+    finally:
+        for w in workers:
+            w.stop()
+        gw0.close()
+        gw1.close()
+        svc0.close()
+        svc1.close()
+
+
+# ---------------------------------------------------------------------------
+# no-negotiation degrade: old peers never produce wrong results
+# ---------------------------------------------------------------------------
+
+def test_non_filtering_worker_unroutable_gateway_serves_locally(
+        attr_store, mesh):
+    """A worker that did not negotiate FLAG_FILTERS (serve.filters off —
+    the pre-attrs build) is simply not a candidate for filtered
+    requests: the gateway's own filtered view answers its partition,
+    byte-identical to the local oracle — never unfiltered results."""
+    from dnn_page_vectors_tpu.infer.partition_host import (PartitionWorker,
+                                                           WorkerGateway)
+    import dataclasses
+    qv = _qv(3, seed=19)
+    svc = _service(attr_store, mesh, partitions=2, replicas=1,
+                   heartbeat_s=0.5)
+    oracle = {t: svc.topk_vectors(qv, k=10, filters=t) for t, _ in ARMS}
+    unf_oracle = svc.topk_vectors(qv, k=10)
+    gw = WorkerGateway(svc, heartbeat_s=0.5)
+    svc.attach_gateway(gw)
+    cfg = get_config("cdssm_toy", {"model.out_dim": DIM,
+                                   "serve.partitions": 2})
+    old_cfg = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    filters=False))
+    workers = []
+    try:
+        for p in range(2):
+            w = PartitionWorker(old_cfg, attr_store.directory,
+                                ("127.0.0.1", gw.port), partition=p,
+                                partitions=2, replica=0, mesh=mesh)
+            threading.Thread(target=w.run, daemon=True).start()
+            workers.append(w)
+        assert gw.wait_for_workers(2, timeout_s=60.0)
+        assert gw.stats()["workers_filtering"] == 0
+        for text, _ in ARMS:
+            bs, bi = oracle[text]
+            s, ids = svc.topk_vectors(qv, k=10, filters=text)
+            assert np.array_equal(ids, bi), text
+            assert np.array_equal(s, bs)
+        # unfiltered requests still fan out to the legacy workers
+        s, ids = svc.topk_vectors(qv, k=10)
+        assert np.array_equal(ids, unf_oracle[1])
+    finally:
+        for w in workers:
+            w.stop()
+        gw.close()
+        svc.close()
+
+
+def test_socket_client_refuses_unnegotiated_filters(attr_store, mesh):
+    """Against a server that never confirmed FLAG_FILTERS the client
+    raises instead of silently serving unfiltered results; unfiltered
+    requests on the same connection keep working."""
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    from dnn_page_vectors_tpu.infer.transport import (RemoteError,
+                                                      SocketSearchClient)
+    svc = _service(attr_store, mesh, filters=False)
+    srv = serve_in_background(svc)
+    client = SocketSearchClient(srv.host, srv.port)
+    qv = _qv(2, seed=23)
+    try:
+        s, ids, _ = client.topk_vectors(qv, k=10)       # negotiates HELLO
+        base_s, base_i = svc.topk_vectors(qv, k=10)
+        assert np.array_equal(ids, base_i)
+        with pytest.raises(RemoteError):
+            client.topk_vectors(qv, k=10, filters="lang==1")
+        s2, i2, _ = client.topk_vectors(qv, k=10)       # still serving
+        assert np.array_equal(i2, base_i)
+    finally:
+        client.close()
+        srv.close()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# result cache: the canonical predicate is part of the key
+# ---------------------------------------------------------------------------
+
+def test_result_cache_never_crosses_filter_boundary(attr_store, mesh):
+    """An unfiltered entry must never serve a filtered request (or the
+    reverse), on the local, partitioned, and socket paths. The planted
+    check: the unfiltered top set contains lang==0 rows, so a filter
+    crossover is observably wrong."""
+    from dnn_page_vectors_tpu.infer.server import serve_in_background
+    from dnn_page_vectors_tpu.infer.transport import SocketSearchClient
+    words = _words()
+    q = "query zero"
+    for topo in ("local", "p2r2", "socket"):
+        over = dict(result_cache=True)
+        if topo == "p2r2":
+            over.update(partitions=2, replicas=2)
+        svc = _service(attr_store, mesh, **over)
+        srv = client = None
+        try:
+            if topo == "socket":
+                srv = serve_in_background(svc)
+                client = SocketSearchClient(srv.host, srv.port)
+                search = client.search
+            else:
+                search = svc.search
+            unfiltered = search(q, k=10)
+            assert any(A.unpack_word(words[r["page_id"]])[0] == 0
+                       for r in unfiltered), "planted check needs lang==0"
+            # same text, filtered: a cache crossover would replay the
+            # unfiltered rows — every row must satisfy the predicate
+            filtered = search(q, k=10, filters="lang==1")
+            assert filtered and filtered != unfiltered
+            for r in filtered:
+                assert A.unpack_word(words[r["page_id"]])[0] == 1
+            # the filtered entry is cached under its own key: a repeat
+            # serves the SAME filtered rows, and the unfiltered entry
+            # is untouched
+            assert search(q, k=10, filters="lang==1") == filtered
+            assert search(q, k=10) == unfiltered
+            # canonical form keys the cache: a differently-spelled
+            # equivalent predicate hits the same entry
+            met0 = svc.metrics().get("result_cache") or {}
+            assert search(q, k=10, filters=" lang == 1 ") == filtered
+            met1 = svc.metrics().get("result_cache") or {}
+            assert met1.get("hits", 0) > met0.get("hits", 0)
+        finally:
+            if client is not None:
+                client.close()
+            if srv is not None:
+                srv.close()
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# attributes survive append -> compact -> migrate
+# ---------------------------------------------------------------------------
+
+def test_attrs_survive_append_compact_migrate(tmp_path, mesh):
+    from dnn_page_vectors_tpu.maintenance.compact import compact_store
+    from dnn_page_vectors_tpu.maintenance.migrate import migrate_store
+    sdir = str(tmp_path / "store")
+    rng = np.random.default_rng(2)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    store.ensure_model_step(1)
+    store.init_attrs()
+    base_words = _words(2 * SHARD)
+    for si in range(2):
+        lo = si * SHARD
+        v = rng.standard_normal((SHARD, DIM)).astype(np.float32)
+        store.write_shard(si, np.arange(lo, lo + SHARD, dtype=np.int64),
+                          v, attrs=base_words[lo:lo + SHARD])
+    store = VectorStore(sdir)
+    # append a generation carrying its own words + tombstone two rows
+    new_ids = np.arange(100, 120, dtype=np.int64)
+    new_words = A.pack_words(lang=np.full(20, 5, np.uint32),
+                             site=np.full(20, 9, np.uint32),
+                             recency=np.full(20, 2, np.uint32))
+    w = store.begin_generation(tombstones=[3, 7])
+    w.write_shard(new_ids, rng.standard_normal((20, DIM)).astype(
+        np.float32), attrs=new_words)
+    w.commit()
+    store = VectorStore(sdir)
+    expect = {int(i): int(wd) for i, wd in enumerate(base_words)}
+    expect.update({int(i): int(wd) for i, wd in zip(new_ids, new_words)})
+    for dead in (3, 7):
+        expect.pop(dead)
+
+    def _check(store, what):
+        got = {}
+        for e in store.shards():
+            ids = store._load_entry(e)[0]
+            for pid, wd in zip(ids, store.load_attrs(e)):
+                if pid >= 0:        # tombstones mask to -1 at load
+                    got[int(pid)] = int(wd)
+        assert got == expect, what
+
+    _check(store, "after append")
+    stats = compact_store(store)
+    assert stats.get("action") != "noop"
+    store = VectorStore(sdir)
+    _check(store, "after compact")
+
+    class _Corpus:
+        def page_text(self, i):
+            return f"page {int(i)}"
+
+    class _Embedder:
+        step, params, mesh = 2, ("tower", 2), None
+        query_tok = page_tok = None
+
+        def embed_texts(self, texts, tower="page", batch_size=None):
+            out = np.stack([np.random.default_rng(
+                len(t)).standard_normal(DIM).astype(np.float32)
+                for t in texts])
+            return out / np.linalg.norm(out, axis=1, keepdims=True)
+
+    out = migrate_store(VectorStore(sdir), _Corpus(), _Embedder(), 2)
+    assert out["action"] == "migrated" and out["units"] > 0
+    store = VectorStore(sdir)
+    assert store.model_steps() == [2]
+    _check(store, "after migrate")
+
+
+def test_append_without_attr_table_refuses(tmp_path, mesh):
+    """--attrs against a store with no attribute table is an explicit
+    error (never a silent zero-fill), and init_attrs unlocks it."""
+    from dnn_page_vectors_tpu.updates import append_corpus
+    sdir = str(tmp_path / "plain")
+    rng = np.random.default_rng(3)
+    store = VectorStore(sdir, dim=DIM, shard_size=SHARD)
+    store.ensure_model_step(0)
+    store.write_shard(0, np.arange(SHARD, dtype=np.int64),
+                      rng.standard_normal((SHARD, DIM)).astype(np.float32))
+    store = VectorStore(sdir)
+    assert not store.attrs_enabled
+    with pytest.raises(ValueError, match="no attribute table"):
+        append_corpus(None, None, store, attrs=A.pack_word(lang=1))
+    with pytest.raises(ValueError):
+        store.write_shard(1, np.arange(SHARD, 2 * SHARD, dtype=np.int64),
+                          rng.standard_normal((SHARD, DIM)).astype(
+                              np.float32),
+                          attrs=np.zeros(SHARD, np.uint32))
+    store.init_attrs()
+    store = VectorStore(sdir)
+    assert store.attrs_enabled
+    # pre-attrs shards read as the all-zero default word
+    entry = store.shards()[0]
+    assert not store.load_attrs(entry).any()
+
+
+# ---------------------------------------------------------------------------
+# loadgen: seeded filtered mix determinism
+# ---------------------------------------------------------------------------
+
+def test_filtered_workload_mix_is_seeded_and_additive():
+    from dnn_page_vectors_tpu.loadgen.workload import (
+        DEFAULT_FILTER_SCENARIOS, make_workload)
+    plain = make_workload("poisson", seed=5, distinct=16,
+                          profile=((10, None, 1.0),))
+    plain2 = make_workload("poisson", seed=5, distinct=16,
+                           profile=((10, None, 1.0),))
+    # the unfiltered stream is byte-identical with and without the
+    # scenario machinery available (no extra RNG draws)
+    base = plain.schedule(3.0, 50.0)
+    assert base == plain2.schedule(3.0, 50.0)
+    wl = make_workload("poisson", seed=5, distinct=16,
+                       profile=((10, None, 1.0),),
+                       filter_scenarios=DEFAULT_FILTER_SCENARIOS)
+    wl2 = make_workload("poisson", seed=5, distinct=16,
+                        profile=((10, None, 1.0),),
+                        filter_scenarios=DEFAULT_FILTER_SCENARIOS)
+    sched = wl.schedule(3.0, 50.0)
+    assert sched == wl2.schedule(3.0, 50.0)
+    assert wl.digest(sched) == wl2.digest(sched)
+    # arrival times and query ids match the plain stream exactly: the
+    # scenario draw rides on top, it never perturbs the schedule
+    assert [t for t, _ in sched] == [t for t, _ in base]
+    assert [r.query_id for _, r in sched] == [r.query_id for _, r in base]
+    seen = {r.scenario for _, r in sched}
+    assert "unfiltered" in seen and len(seen) > 1
+    for _, r in sched:
+        if r.filters is not None:
+            # predicates are stored in canonical form
+            assert r.filters == A.Predicate.parse(r.filters).text
+    # an unfiltered schedule's digest is byte-identical to the
+    # pre-filters format; a filtered schedule's is tagged
+    assert wl.digest(base) == plain.digest(base)
+    assert wl.digest(sched) != plain.digest(base)

@@ -406,7 +406,8 @@ def test_door_shed_when_deadline_expires_in_queue(net_store, mesh):
     b = svc._batcher
     try:
         fut: Future = Future()
-        item = ("q", (10, None), fut, 0.0, None, svc.default_deadline(5.0))
+        item = ("q", (10, None, None), fut, 0.0, None,
+                svc.default_deadline(5.0))
         fake["t"] += 1.0                         # expires in the queue
         n_batches = len(b.batch_sizes)
         b._dispatch([item])
@@ -421,8 +422,8 @@ def test_door_shed_when_deadline_expires_in_queue(net_store, mesh):
         dead: Future = Future()
         live: Future = Future()
         b._dispatch([
-            ("d", (10, None), dead, 0.0, None, fake["t"] - 0.001),
-            ("l", (10, None), live, 0.0, None, None)])
+            ("d", (10, None, None), dead, 0.0, None, fake["t"] - 0.001),
+            ("l", (10, None, None), live, 0.0, None, None)])
         assert live.result(timeout=30)
         with pytest.raises(DeadlineExceeded):
             dead.result(timeout=5)
